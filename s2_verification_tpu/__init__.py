@@ -1,0 +1,18 @@
+"""s2_verification_tpu — a TPU-native linearizability-verification framework.
+
+A ground-up rebuild of the capabilities of ``s2-streamstore/s2-verification``
+(history collection against an S2-style stream store + Porcupine-based
+linearizability checking), designed JAX/XLA-first:
+
+- ``utils``     — chain-hash protocol, JSONL event wire format, tracing, config
+- ``models``    — the S2 stream semantic model (python oracle + array encoding)
+- ``checker``   — search engines: CPU Wing–Gong DFS oracle, TPU frontier search
+- ``ops``       — device kernels: u64-pair math, XXH3, the Step transition kernel
+- ``parallel``  — device mesh + shard_map'd multi-chip frontier search
+- ``collector`` — in-process fake S2 service + workload clients + collect CLI
+- ``viz``       — HTML visualization of (partial) linearizations
+"""
+
+from .version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,3 @@
+from . import events, hashing
+
+__all__ = ["events", "hashing"]

@@ -1,0 +1,396 @@
+"""History event vocabulary and its JSONL wire format.
+
+The on-disk history format is line-oriented JSON records, wire-compatible with
+the reference collector's serde encoding (rust/s2-verification/src/history.rs:84-137)
+and the reference checker's decoder (golang/s2-porcupine/main.go:18-194):
+
+  - unit enum variants encode as bare strings: ``{"event":{"Start":"Read"},...}``
+  - struct variants encode as single-key objects:
+    ``{"event":{"Start":{"Append":{"num_records":...,...}}},...}``
+  - every record carries ``client_id`` and ``op_id``.
+
+Decoding follows Go's ``json.Decoder`` semantics (a stream of concatenated
+JSON values, not a line scanner), so arbitrarily large records are fine
+(golang/s2-porcupine/main_test.go:34-101).  Validation matches the reference:
+an ``Append`` start must carry exactly ``num_records`` record hashes
+(main.go:62-64) and each record must hold exactly one of Start/Finish
+(main.go:184-186).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from dataclasses import dataclass, field
+from typing import Iterator, Union
+
+__all__ = [
+    "AppendStart",
+    "ReadStart",
+    "CheckTailStart",
+    "AppendSuccess",
+    "AppendDefiniteFailure",
+    "AppendIndefiniteFailure",
+    "ReadSuccess",
+    "ReadFailure",
+    "CheckTailSuccess",
+    "CheckTailFailure",
+    "Start",
+    "Finish",
+    "LabeledEvent",
+    "DecodeError",
+    "encode_event",
+    "event_to_obj",
+    "decode_obj",
+    "iter_history",
+    "read_history",
+    "write_history",
+]
+
+
+class DecodeError(ValueError):
+    """A history record failed to decode or validate."""
+
+
+# --------------------------------------------------------------------------
+# Call-start variants
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AppendStart:
+    num_records: int
+    #: xxh3 of each record body in the batch, in order; the model folds these
+    #: onto its cumulative stream hash.
+    record_hashes: tuple[int, ...] = ()
+    set_fencing_token: str | None = None
+    fencing_token: str | None = None
+    match_seq_num: int | None = None
+
+    def __post_init__(self) -> None:
+        if len(self.record_hashes) != self.num_records:
+            raise ValueError(
+                f"append has {len(self.record_hashes)} record_hashes "
+                f"but {self.num_records} records"
+            )
+
+
+@dataclass(frozen=True)
+class ReadStart:
+    pass
+
+
+@dataclass(frozen=True)
+class CheckTailStart:
+    pass
+
+
+Start = Union[AppendStart, ReadStart, CheckTailStart]
+
+
+# --------------------------------------------------------------------------
+# Call-finish variants
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AppendSuccess:
+    tail: int
+
+
+@dataclass(frozen=True)
+class AppendDefiniteFailure:
+    pass
+
+
+@dataclass(frozen=True)
+class AppendIndefiniteFailure:
+    pass
+
+
+@dataclass(frozen=True)
+class ReadSuccess:
+    tail: int
+    #: Cumulative chain hash over every record body from the head of the
+    #: stream through the tail.
+    stream_hash: int
+
+
+@dataclass(frozen=True)
+class ReadFailure:
+    pass
+
+
+@dataclass(frozen=True)
+class CheckTailSuccess:
+    tail: int
+
+
+@dataclass(frozen=True)
+class CheckTailFailure:
+    pass
+
+
+Finish = Union[
+    AppendSuccess,
+    AppendDefiniteFailure,
+    AppendIndefiniteFailure,
+    ReadSuccess,
+    ReadFailure,
+    CheckTailSuccess,
+    CheckTailFailure,
+]
+
+_START_TYPES = (AppendStart, ReadStart, CheckTailStart)
+
+
+@dataclass(frozen=True)
+class LabeledEvent:
+    """One history record: a call start or finish, tagged with identity.
+
+    ``client_id`` scopes real-time ordering (ops within a client are
+    sequential); ``op_id`` pairs a start with its finish.
+    """
+
+    event: Start | Finish
+    client_id: int
+    op_id: int
+
+    @property
+    def is_start(self) -> bool:
+        return isinstance(self.event, _START_TYPES)
+
+
+# --------------------------------------------------------------------------
+# Encoding
+# --------------------------------------------------------------------------
+
+_UNIT_VARIANTS: dict[type, str] = {
+    ReadStart: "Read",
+    CheckTailStart: "CheckTail",
+    AppendDefiniteFailure: "AppendDefiniteFailure",
+    AppendIndefiniteFailure: "AppendIndefiniteFailure",
+    ReadFailure: "ReadFailure",
+    CheckTailFailure: "CheckTailFailure",
+}
+
+
+def _payload_to_obj(ev: Start | Finish) -> object:
+    name = _UNIT_VARIANTS.get(type(ev))
+    if name is not None:
+        return name
+    if isinstance(ev, AppendStart):
+        return {
+            "Append": {
+                "num_records": ev.num_records,
+                "record_hashes": list(ev.record_hashes),
+                "set_fencing_token": ev.set_fencing_token,
+                "fencing_token": ev.fencing_token,
+                "match_seq_num": ev.match_seq_num,
+            }
+        }
+    if isinstance(ev, AppendSuccess):
+        return {"AppendSuccess": {"tail": ev.tail}}
+    if isinstance(ev, ReadSuccess):
+        return {"ReadSuccess": {"tail": ev.tail, "stream_hash": ev.stream_hash}}
+    if isinstance(ev, CheckTailSuccess):
+        return {"CheckTailSuccess": {"tail": ev.tail}}
+    raise TypeError(f"unknown event payload: {ev!r}")
+
+
+def event_to_obj(le: LabeledEvent) -> dict:
+    side = "Start" if le.is_start else "Finish"
+    return {
+        "event": {side: _payload_to_obj(le.event)},
+        "client_id": le.client_id,
+        "op_id": le.op_id,
+    }
+
+
+def encode_event(le: LabeledEvent) -> str:
+    """One JSONL line (no trailing newline)."""
+    return json.dumps(event_to_obj(le), separators=(",", ":"))
+
+
+# --------------------------------------------------------------------------
+# Decoding
+# --------------------------------------------------------------------------
+
+
+_U64_MAX = (1 << 64) - 1
+
+
+def _require_int(obj: object, key: str, ctx: str, u64: bool = False) -> int:
+    if not isinstance(obj, dict):
+        raise DecodeError(f"{ctx}: expected an object body, got {obj!r}")
+    v = obj.get(key)
+    if not isinstance(v, int) or isinstance(v, bool):
+        raise DecodeError(f"{ctx}: expected integer {key!r}, got {v!r}")
+    if v < 0 or (u64 and v > _U64_MAX):
+        raise DecodeError(f"{ctx}: {key!r} out of range: {v}")
+    return v
+
+
+def _opt_str(obj: dict, key: str, ctx: str) -> str | None:
+    v = obj.get(key)
+    if v is None or isinstance(v, str):
+        return v
+    raise DecodeError(f"{ctx}: expected string-or-null {key!r}, got {v!r}")
+
+
+def _decode_start(data: object) -> Start:
+    if isinstance(data, str):
+        if data == "Read":
+            return ReadStart()
+        if data == "CheckTail":
+            return CheckTailStart()
+        raise DecodeError(f"unknown string start event: {data}")
+    if isinstance(data, dict):
+        if "Append" in data:
+            args = data["Append"]
+            if not isinstance(args, dict):
+                raise DecodeError("Append args must be an object")
+            hashes = args.get("record_hashes")
+            if hashes is None:
+                hashes = []
+            if not isinstance(hashes, list) or not all(
+                isinstance(h, int) and not isinstance(h, bool) and 0 <= h <= _U64_MAX
+                for h in hashes
+            ):
+                raise DecodeError("record_hashes must be a list of u64 integers")
+            num = _require_int(args, "num_records", "Append")
+            match = args.get("match_seq_num")
+            if match is not None and (
+                not isinstance(match, int) or isinstance(match, bool) or match < 0
+            ):
+                raise DecodeError(f"Append: bad match_seq_num {match!r}")
+            try:
+                return AppendStart(
+                    num_records=num,
+                    record_hashes=tuple(hashes),
+                    set_fencing_token=_opt_str(args, "set_fencing_token", "Append"),
+                    fencing_token=_opt_str(args, "fencing_token", "Append"),
+                    match_seq_num=match,
+                )
+            except ValueError as e:
+                raise DecodeError(str(e)) from None
+    raise DecodeError("unknown start event format")
+
+
+def _decode_finish(data: object) -> Finish:
+    if isinstance(data, str):
+        unit = {
+            "AppendDefiniteFailure": AppendDefiniteFailure,
+            "AppendIndefiniteFailure": AppendIndefiniteFailure,
+            "ReadFailure": ReadFailure,
+            "CheckTailFailure": CheckTailFailure,
+        }.get(data)
+        if unit is None:
+            raise DecodeError(f"unknown string finish event: {data}")
+        return unit()
+    if isinstance(data, dict):
+        if "AppendSuccess" in data:
+            body = data["AppendSuccess"]
+            return AppendSuccess(tail=_require_int(body, "tail", "AppendSuccess"))
+        if "ReadSuccess" in data:
+            body = data["ReadSuccess"]
+            return ReadSuccess(
+                tail=_require_int(body, "tail", "ReadSuccess"),
+                stream_hash=_require_int(body, "stream_hash", "ReadSuccess", u64=True),
+            )
+        if "CheckTailSuccess" in data:
+            body = data["CheckTailSuccess"]
+            return CheckTailSuccess(tail=_require_int(body, "tail", "CheckTailSuccess"))
+    raise DecodeError("unknown finish event format")
+
+
+def decode_obj(obj: object) -> LabeledEvent:
+    """Decode one parsed JSON record into a :class:`LabeledEvent`."""
+    if not isinstance(obj, dict):
+        raise DecodeError(f"history record must be an object, got {type(obj).__name__}")
+    ev = obj.get("event")
+    if not isinstance(ev, dict):
+        raise DecodeError("missing 'event' object")
+    has_start = "Start" in ev
+    has_finish = "Finish" in ev
+    if has_start == has_finish:
+        raise DecodeError(
+            f"expected exactly one of Start/Finish, got Start={has_start} Finish={has_finish}"
+        )
+    payload: Start | Finish
+    if has_start:
+        payload = _decode_start(ev["Start"])
+    else:
+        payload = _decode_finish(ev["Finish"])
+    return LabeledEvent(
+        event=payload,
+        client_id=_require_int(obj, "client_id", "record"),
+        op_id=_require_int(obj, "op_id", "record"),
+    )
+
+
+def iter_history(stream: io.TextIOBase | str) -> Iterator[LabeledEvent]:
+    """Decode a stream of concatenated JSON records (JSONL or denser).
+
+    Mirrors Go ``json.Decoder`` semantics: values may span or share lines and
+    may be arbitrarily large.  Raises :class:`DecodeError` with the byte
+    offset of the first malformed value.
+    """
+    if isinstance(stream, str):
+        stream = io.StringIO(stream)
+    decoder = json.JSONDecoder()
+    buf = ""
+    pos = 0  # cursor into buf
+    consumed = 0  # chars consumed before buf[0]
+    eof = False
+    while True:
+        while pos < len(buf) and buf[pos].isspace():
+            pos += 1
+        if pos < len(buf):
+            try:
+                obj, end = decoder.raw_decode(buf, pos)
+            except json.JSONDecodeError:
+                if not eof:
+                    # Possibly a value truncated at the chunk boundary: compact
+                    # the buffer and read more.
+                    buf = buf[pos:]
+                    consumed += pos
+                    pos = 0
+                    chunk = stream.read(1 << 20)
+                    if chunk:
+                        buf += chunk
+                    else:
+                        eof = True
+                    continue
+                raise DecodeError(
+                    f"decode record at offset {consumed + pos}: malformed JSON"
+                )
+            try:
+                yield decode_obj(obj)
+            except DecodeError as e:
+                raise DecodeError(
+                    f"decode record at offset {consumed + pos}: {e}"
+                ) from None
+            pos = end
+            continue
+        if eof:
+            return
+        buf = ""
+        consumed += pos
+        pos = 0
+        chunk = stream.read(1 << 20)
+        if not chunk:
+            eof = True
+        else:
+            buf = chunk
+
+
+def read_history(path: str) -> list[LabeledEvent]:
+    with open(path, "r", encoding="utf-8") as f:
+        return list(iter_history(f))
+
+
+def write_history(events: list[LabeledEvent], stream: io.TextIOBase) -> None:
+    for le in events:
+        stream.write(encode_event(le))
+        stream.write("\n")

@@ -1,0 +1,53 @@
+"""Chained xxh3 stream-hash protocol (host-side reference implementation).
+
+The cumulative hash over a stream is the left fold of :func:`chain_hash` over
+the xxh3-64 of every record body, in sequence order, starting from 0 for the
+empty stream.  Each value commits to the entire stream prefix, which lets the
+linearizability model keep a constant-size state instead of the stream
+contents.
+
+Wire/protocol parity with the reference implementation:
+  - rust/s2-verification/src/history.rs:43-45 (``chain_hash``)
+  - golang/s2-porcupine/main.go:232-244 (``chainHash`` / ``foldRecordHashes``)
+Pinned cross-language test vectors: history.rs:687-696, main_test.go:15-32.
+
+The JAX/TPU implementation of the same function lives in
+``s2_verification_tpu.ops.xxh3`` and is differential-tested against this one.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections.abc import Iterable
+
+import xxhash
+
+__all__ = ["record_hash", "chain_hash", "fold_record_hashes", "stream_hash_of_bodies"]
+
+_U64 = struct.Struct("<Q")
+
+
+def record_hash(body: bytes) -> int:
+    """xxh3-64 (no seed) of one record body."""
+    return xxhash.xxh3_64_intdigest(body)
+
+
+def chain_hash(stream_hash: int, rec_hash: int) -> int:
+    """Fold one record-body hash into a cumulative stream hash.
+
+    Defined as ``xxh3_64(le_bytes(rec_hash), seed=stream_hash)``.
+    """
+    return xxhash.xxh3_64_intdigest(_U64.pack(rec_hash & 0xFFFFFFFFFFFFFFFF), seed=stream_hash)
+
+
+def fold_record_hashes(stream_hash: int, rec_hashes: Iterable[int]) -> int:
+    """Left-fold :func:`chain_hash` over a batch of record hashes."""
+    acc = stream_hash
+    for rh in rec_hashes:
+        acc = chain_hash(acc, rh)
+    return acc
+
+
+def stream_hash_of_bodies(bodies: Iterable[bytes]) -> int:
+    """Cumulative hash of an entire stream given every record body in order."""
+    return fold_record_hashes(0, (record_hash(b) for b in bodies))

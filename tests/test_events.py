@@ -1,0 +1,178 @@
+"""Wire-format conformance for the JSONL history encoding.
+
+Pins the serde-compatible encoding used by the reference
+(history.rs:698-706 for the ReadSuccess wire shape; main.go:18-194 for the
+decoder's variant handling; main_test.go:34-126 for large-line and
+malformed-input behavior).
+"""
+
+import io
+import json
+
+import pytest
+
+from s2_verification_tpu.utils.events import (
+    AppendDefiniteFailure,
+    AppendIndefiniteFailure,
+    AppendStart,
+    AppendSuccess,
+    CheckTailFailure,
+    CheckTailStart,
+    CheckTailSuccess,
+    DecodeError,
+    LabeledEvent,
+    ReadFailure,
+    ReadStart,
+    ReadSuccess,
+    decode_obj,
+    encode_event,
+    iter_history,
+    write_history,
+)
+
+
+def roundtrip(le):
+    [out] = list(iter_history(encode_event(le)))
+    return out
+
+
+def test_read_success_wire_shape():
+    le = LabeledEvent(ReadSuccess(tail=7, stream_hash=42), client_id=1, op_id=2)
+    line = encode_event(le)
+    obj = json.loads(line)
+    assert obj["event"]["Finish"] == {"ReadSuccess": {"tail": 7, "stream_hash": 42}}
+    assert obj["client_id"] == 1 and obj["op_id"] == 2
+    assert roundtrip(le) == le
+
+
+def test_unit_variants_encode_as_strings():
+    for payload, name in [
+        (ReadStart(), "Read"),
+        (CheckTailStart(), "CheckTail"),
+    ]:
+        obj = json.loads(encode_event(LabeledEvent(payload, 0, 0)))
+        assert obj["event"]["Start"] == name
+    for payload, name in [
+        (AppendDefiniteFailure(), "AppendDefiniteFailure"),
+        (AppendIndefiniteFailure(), "AppendIndefiniteFailure"),
+        (ReadFailure(), "ReadFailure"),
+        (CheckTailFailure(), "CheckTailFailure"),
+    ]:
+        obj = json.loads(encode_event(LabeledEvent(payload, 0, 0)))
+        assert obj["event"]["Finish"] == name
+
+
+def test_append_roundtrip_with_options():
+    le = LabeledEvent(
+        AppendStart(
+            num_records=2,
+            record_hashes=(1, 2),
+            set_fencing_token="tok123",
+            fencing_token=None,
+            match_seq_num=9,
+        ),
+        client_id=3,
+        op_id=17,
+    )
+    obj = json.loads(encode_event(le))
+    args = obj["event"]["Start"]["Append"]
+    assert args == {
+        "num_records": 2,
+        "record_hashes": [1, 2],
+        "set_fencing_token": "tok123",
+        "fencing_token": None,
+        "match_seq_num": 9,
+    }
+    assert roundtrip(le) == le
+
+
+def test_all_finish_variants_roundtrip():
+    for payload in [
+        AppendSuccess(tail=4),
+        AppendDefiniteFailure(),
+        AppendIndefiniteFailure(),
+        ReadSuccess(tail=0, stream_hash=0),
+        ReadFailure(),
+        CheckTailSuccess(tail=123),
+        CheckTailFailure(),
+    ]:
+        le = LabeledEvent(payload, client_id=5, op_id=6)
+        assert roundtrip(le) == le
+
+
+def test_large_record_hash_line_decodes():
+    # Mirrors main_test.go:34-101: a 5000-hash append line exceeds 64 KiB and
+    # must still decode (the reference uses json.Decoder, not a line scanner).
+    n = 5000
+    hashes = tuple((2**64 - 1) - i for i in range(n))
+    start = LabeledEvent(AppendStart(num_records=n, record_hashes=hashes), 0, 0)
+    finish = LabeledEvent(AppendSuccess(tail=n), 0, 0)
+    buf = io.StringIO()
+    write_history([start, finish], buf)
+    first_line = buf.getvalue().split("\n", 1)[0]
+    assert len(first_line) > 64 * 1024
+    events = list(iter_history(io.StringIO(buf.getvalue())))
+    assert len(events) == 2
+    assert events[0].event.record_hashes == hashes
+
+
+def test_malformed_json_rejected():
+    # main_test.go:103-108
+    with pytest.raises(DecodeError):
+        list(iter_history('{"event":{"Start":"Read"},"client_id":1,"op_id":1'))
+
+
+def test_record_hash_count_mismatch_rejected():
+    # main.go:62-64
+    obj = {
+        "event": {
+            "Start": {
+                "Append": {
+                    "num_records": 3,
+                    "record_hashes": [1, 2],
+                    "set_fencing_token": None,
+                    "fencing_token": None,
+                    "match_seq_num": None,
+                }
+            }
+        },
+        "client_id": 0,
+        "op_id": 0,
+    }
+    with pytest.raises(DecodeError, match="record_hashes"):
+        decode_obj(obj)
+
+
+def test_exactly_one_of_start_finish():
+    # main.go:184-186
+    both = {
+        "event": {"Start": "Read", "Finish": "ReadFailure"},
+        "client_id": 0,
+        "op_id": 0,
+    }
+    with pytest.raises(DecodeError, match="exactly one"):
+        decode_obj(both)
+    neither = {"event": {}, "client_id": 0, "op_id": 0}
+    with pytest.raises(DecodeError, match="exactly one"):
+        decode_obj(neither)
+
+
+def test_unknown_variants_rejected():
+    with pytest.raises(DecodeError, match="unknown string start"):
+        decode_obj({"event": {"Start": "Bogus"}, "client_id": 0, "op_id": 0})
+    with pytest.raises(DecodeError, match="unknown string finish"):
+        decode_obj({"event": {"Finish": "Bogus"}, "client_id": 0, "op_id": 0})
+    with pytest.raises(DecodeError, match="unknown finish"):
+        decode_obj({"event": {"Finish": {"Bogus": {}}}, "client_id": 0, "op_id": 0})
+
+
+def test_multi_value_stream_with_whitespace():
+    text = (
+        '{"event":{"Start":"Read"},"client_id":1,"op_id":0}\n\n'
+        '  {"event":{"Finish":{"ReadSuccess":{"tail":0,"stream_hash":0}}},'
+        '"client_id":1,"op_id":0}'
+    )
+    events = list(iter_history(text))
+    assert len(events) == 2
+    assert events[0].event == ReadStart()
+    assert events[1].event == ReadSuccess(0, 0)

@@ -1,0 +1,32 @@
+"""Chain-hash protocol conformance.
+
+Pins the cross-language vectors shared by the reference's Rust and Go sides
+(rust/s2-verification/src/history.rs:687-696, golang/s2-porcupine/main_test.go:15-32).
+"""
+
+from s2_verification_tpu.utils import hashing
+
+
+def test_chain_hash_vectors():
+    foo = hashing.record_hash(b"foo")
+    assert foo == 0xAB6E5F64077E7D8A
+    h1 = hashing.chain_hash(0, foo)
+    h2 = hashing.chain_hash(h1, hashing.record_hash(b"bar"))
+    h3 = hashing.chain_hash(h2, hashing.record_hash(b"baz"))
+    assert h1 == 0x4D2B003EE417C3A5
+    assert h2 == 0x132E5D5DD7936EDD
+    assert h3 == 0x732EE99ABC5002FF
+
+
+def test_fold_matches_manual_fold():
+    hs = [11, 22, 33, 44]
+    acc = 0
+    for rh in hs:
+        acc = hashing.chain_hash(acc, rh)
+    assert hashing.fold_record_hashes(0, hs) == acc
+    assert hashing.fold_record_hashes(0, []) == 0
+
+
+def test_stream_hash_of_bodies():
+    bodies = [b"foo", b"bar", b"baz"]
+    assert hashing.stream_hash_of_bodies(bodies) == 0x732EE99ABC5002FF
