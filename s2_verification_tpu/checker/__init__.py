@@ -1,0 +1,3 @@
+from . import entries, oracle
+
+__all__ = ["entries", "oracle"]

@@ -1,0 +1,197 @@
+"""History preparation: events → operations for the search engines.
+
+Converts a decoded event stream (call starts/finishes keyed by ``op_id``,
+mirroring golang/s2-porcupine/main.go:529-563) into an array of operations
+with real-time call/return indices, plus structural metadata the searches
+exploit:
+
+- **Pending-call completion.**  A call with no finish (a client crashed before
+  its deferred indefinite-failure event was flushed) is completed with the
+  weakest consistent output: appends get an indefinite failure (may or may not
+  have applied), reads/check-tails a definite failure.  Its return is placed
+  after every real event, which gives it the reference's open-op semantics:
+  linearizable at any point after its call.
+
+- **Trivial-op elision.**  An op whose output makes ``step`` the identity on
+  *every* state — definite append failures and failed reads/check-tails all
+  return ``{state}`` unconditionally — constrains nothing.  Such an op can be
+  inserted into any legal linearization of the remaining ops (any position
+  after everything that returned before its call and before everything that
+  called after its return; real-time order guarantees such a slot exists), so
+  the searches drop them up front and the result is unchanged.  This is a
+  structural optimization the reference's Porcupine search does not perform.
+
+- **Chain structure.**  Ops within one ``client_id`` are sequential in real
+  time (the collector's clients issue ops one at a time and never reuse a
+  rotated-away client id), so the set of linearized ops within a chain is
+  always a prefix.  The device search encodes a configuration's linearized
+  set as one counter per chain instead of an op bitset.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..models.stream import (
+    APPEND,
+    StreamInput,
+    StreamOutput,
+    input_from_start,
+    output_from_finish,
+)
+from ..utils import events as ev
+
+__all__ = ["Op", "History", "HistoryError", "prepare"]
+
+
+class HistoryError(ValueError):
+    """The event stream is not a well-formed history."""
+
+
+@dataclass(frozen=True)
+class Op:
+    index: int  # dense op index within the prepared history
+    op_id: int  # wire op_id
+    client_id: int
+    call: int  # index of the call event in real time
+    ret: int  # index of the return event; pending ops return after everything
+    inp: StreamInput
+    out: StreamOutput
+    pending: bool = False
+
+    @property
+    def is_indefinite_append(self) -> bool:
+        return (
+            self.inp.input_type == APPEND
+            and self.out.failure
+            and not self.out.definite_failure
+        )
+
+    @property
+    def is_trivial(self) -> bool:
+        """True iff step(s, inp, out) == {s} for every state s."""
+        return self.out.failure and self.out.definite_failure
+
+
+@dataclass
+class History:
+    """A prepared history: search-relevant ops plus elided trivial ops."""
+
+    ops: list[Op]
+    trivial_ops: list[Op] = field(default_factory=list)
+    #: chains[c] = op indices (into ops) of chain c, in call order
+    chains: list[list[int]] = field(default_factory=list)
+    #: chain_of[i] = chain index of ops[i]
+    chain_of: list[int] = field(default_factory=list)
+
+    @property
+    def num_ops(self) -> int:
+        return len(self.ops)
+
+
+def _collect_ops(events: list[ev.LabeledEvent]) -> list[Op]:
+    calls: dict[int, tuple[int, int, StreamInput]] = {}  # op_id -> (time, client, inp)
+    finished: list[Op] = []
+    order: dict[int, int] = {}  # op_id -> arrival order for stable indexing
+    for time, le in enumerate(events):
+        if le.is_start:
+            if le.op_id in calls or le.op_id in order:
+                raise HistoryError(f"duplicate call for op_id {le.op_id}")
+            calls[le.op_id] = (time, le.client_id, input_from_start(le.event))
+            order[le.op_id] = len(order)
+        else:
+            pending = calls.pop(le.op_id, None)
+            if pending is None:
+                raise HistoryError(f"finish without call for op_id {le.op_id}")
+            call_time, client_id, inp = pending
+            if le.client_id != client_id:
+                raise HistoryError(
+                    f"op_id {le.op_id} finished by client {le.client_id} "
+                    f"but called by client {client_id}"
+                )
+            finished.append(
+                Op(
+                    index=-1,
+                    op_id=le.op_id,
+                    client_id=client_id,
+                    call=call_time,
+                    ret=time,
+                    inp=inp,
+                    out=output_from_finish(le.event),
+                )
+            )
+    # Complete pending calls with the weakest consistent output, returning
+    # after every real event.
+    horizon = len(events)
+    for op_id, (call_time, client_id, inp) in sorted(calls.items(), key=lambda kv: kv[1][0]):
+        if inp.input_type == APPEND:
+            out = StreamOutput(failure=True, definite_failure=False)
+        else:
+            out = StreamOutput(failure=True, definite_failure=True)
+        finished.append(
+            Op(
+                index=-1,
+                op_id=op_id,
+                client_id=client_id,
+                call=call_time,
+                ret=horizon,
+                inp=inp,
+                out=out,
+                pending=True,
+            )
+        )
+        horizon += 1
+    finished.sort(key=lambda op: op.call)
+    return finished
+
+
+def prepare(events: list[ev.LabeledEvent], elide_trivial: bool = True) -> History:
+    """Build a :class:`History` from a decoded event stream."""
+    all_ops = _collect_ops(events)
+
+    # Sanity: within a client, ops must be sequential in real time.
+    last_ret: dict[int, tuple[int, int]] = {}
+    for op in all_ops:
+        prev = last_ret.get(op.client_id)
+        if prev is not None and op.call < prev[0]:
+            raise HistoryError(
+                f"client {op.client_id} has overlapping ops "
+                f"{prev[1]} and {op.op_id}: histories must be sequential per client"
+            )
+        last_ret[op.client_id] = (op.ret, op.op_id)
+
+    kept: list[Op] = []
+    trivial: list[Op] = []
+    for op in all_ops:
+        if elide_trivial and op.is_trivial:
+            trivial.append(op)
+        else:
+            kept.append(op)
+
+    ops = [
+        Op(
+            index=i,
+            op_id=op.op_id,
+            client_id=op.client_id,
+            call=op.call,
+            ret=op.ret,
+            inp=op.inp,
+            out=op.out,
+            pending=op.pending,
+        )
+        for i, op in enumerate(kept)
+    ]
+
+    chain_index: dict[int, int] = {}
+    chains: list[list[int]] = []
+    chain_of: list[int] = []
+    for op in ops:
+        c = chain_index.get(op.client_id)
+        if c is None:
+            c = len(chains)
+            chain_index[op.client_id] = c
+            chains.append([])
+        chains[c].append(op.index)
+        chain_of.append(c)
+
+    return History(ops=ops, trivial_ops=trivial, chains=chains, chain_of=chain_of)

@@ -1,0 +1,222 @@
+"""CPU oracle: Wing–Gong linearizability DFS with just-in-time memoization.
+
+This is the default backend and the correctness oracle for the TPU frontier
+search.  It re-implements the published algorithm the reference relies on via
+its Porcupine dependency (Wing & Gong 1993; Lowe 2017), specialized to the
+powerset-lifted nondeterministic stream model (SURVEY.md §1-L4, §3.5):
+
+- entries are the call/return events in real-time order, on a doubly-linked
+  list;
+- at each step, try to linearize some pending call by applying the model's
+  ``step_set`` to the current candidate-state set; commit if the result is
+  non-empty and the ``(linearized-op bitset, state set)`` pair is unseen;
+- reaching a return with nothing linearizable backtracks.
+
+Result semantics match ``porcupine.CheckEventsVerbose(model, events, 0)``
+(golang/s2-porcupine/main.go:605-606): OK iff some total order of all ops,
+consistent with real time, drives the state-set through every observation
+without emptying it.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from enum import Enum
+
+from ..models.stream import INIT_STATE, StreamState, step_set
+from .entries import History, Op
+
+__all__ = ["CheckOutcome", "CheckResult", "check", "check_events"]
+
+
+class CheckOutcome(Enum):
+    OK = "ok"
+    ILLEGAL = "illegal"
+    UNKNOWN = "unknown"  # time budget exhausted before a verdict
+
+
+@dataclass
+class CheckResult:
+    outcome: CheckOutcome
+    #: op indices (into History.ops) in linearization order, when OK
+    linearization: list[int] | None = None
+    #: deepest set of linearized op indices reached, for diagnostics/viz
+    deepest: list[int] = field(default_factory=list)
+    #: states consistent with the full linearization, when OK
+    final_states: list[StreamState] = field(default_factory=list)
+    #: search statistics
+    steps: int = 0
+    cache_hits: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return self.outcome == CheckOutcome.OK
+
+
+class _Entry:
+    __slots__ = ("op", "is_call", "match", "prev", "nxt")
+
+    def __init__(self, op: Op | None, is_call: bool):
+        self.op = op
+        self.is_call = is_call
+        self.match: _Entry | None = None
+        self.prev: _Entry | None = None
+        self.nxt: _Entry | None = None
+
+
+def _build_entry_list(ops: list[Op]) -> _Entry:
+    """Head sentinel of the doubly-linked call/return entry list."""
+    items: list[tuple[int, _Entry]] = []
+    for op in ops:
+        call = _Entry(op, True)
+        ret = _Entry(op, False)
+        call.match = ret
+        items.append((op.call, call))
+        items.append((op.ret, ret))
+    items.sort(key=lambda t: t[0])
+    head = _Entry(None, False)
+    prev = head
+    for _, e in items:
+        prev.nxt = e
+        e.prev = prev
+        prev = e
+    return head
+
+
+def _lift(call: _Entry) -> None:
+    """Unlink a call entry and its return from the list (order preserved)."""
+    ret = call.match
+    call.prev.nxt = call.nxt
+    if call.nxt is not None:
+        call.nxt.prev = call.prev
+    ret.prev.nxt = ret.nxt
+    if ret.nxt is not None:
+        ret.nxt.prev = ret.prev
+
+
+def _unlift(call: _Entry) -> None:
+    """Reinsert a lifted call/return pair using their remembered neighbors.
+
+    Safe because lifts are undone in LIFO order (the DFS backtracks the most
+    recent commitment first), so the remembered neighbors are still adjacent.
+    """
+    ret = call.match
+    ret.prev.nxt = ret
+    if ret.nxt is not None:
+        ret.nxt.prev = ret
+    call.prev.nxt = call
+    if call.nxt is not None:
+        call.nxt.prev = call
+
+
+def _state_key(states: list[StreamState]) -> frozenset[StreamState]:
+    return frozenset(states)
+
+
+def check(history: History, time_budget_s: float | None = None) -> CheckResult:
+    """Decide linearizability of a prepared history."""
+    ops = history.ops
+    if not ops:
+        return CheckResult(CheckOutcome.OK, linearization=[], final_states=[INIT_STATE])
+
+    head = _build_entry_list(ops)
+    states: list[StreamState] = [INIT_STATE]
+    linearized = 0
+    cache: set[tuple[int, frozenset[StreamState]]] = {(0, _state_key(states))}
+    # Undo stack of (call entry, states before linearizing it).
+    calls: list[tuple[_Entry, list[StreamState]]] = []
+    n_lin = 0
+    best: tuple[int, int] = (0, 0)  # (count, bitset) deepest point reached
+    steps = 0
+    cache_hits = 0
+    deadline = None if time_budget_s is None else _time.monotonic() + time_budget_s
+
+    entry = head.nxt
+    while head.nxt is not None:
+        if deadline is not None and steps % 1024 == 0 and _time.monotonic() > deadline:
+            return CheckResult(
+                CheckOutcome.UNKNOWN,
+                deepest=_bits_to_list(best[1]),
+                steps=steps,
+                cache_hits=cache_hits,
+            )
+        if entry is None:
+            # Fell off the end of the list without crossing a return: every
+            # remaining entry was a call we failed to linearize.  Backtrack.
+            if not calls:
+                return CheckResult(
+                    CheckOutcome.ILLEGAL,
+                    deepest=_bits_to_list(best[1]),
+                    steps=steps,
+                    cache_hits=cache_hits,
+                )
+            entry, states = calls.pop()
+            linearized &= ~(1 << entry.op.index)
+            n_lin -= 1
+            _unlift(entry)
+            entry = entry.nxt
+            continue
+        if entry.is_call:
+            steps += 1
+            op = entry.op
+            new_states = step_set(states, op.inp, op.out)
+            if new_states:
+                new_lin = linearized | (1 << op.index)
+                key = (new_lin, _state_key(new_states))
+                if key not in cache:
+                    cache.add(key)
+                    calls.append((entry, states))
+                    states = new_states
+                    linearized = new_lin
+                    n_lin += 1
+                    if n_lin > best[0]:
+                        best = (n_lin, new_lin)
+                    _lift(entry)
+                    entry = head.nxt
+                    continue
+                cache_hits += 1
+            entry = entry.nxt
+        else:
+            # A return of a not-yet-linearized op: its call must linearize
+            # before real time passes this point.  Backtrack.
+            if not calls:
+                return CheckResult(
+                    CheckOutcome.ILLEGAL,
+                    deepest=_bits_to_list(best[1]),
+                    steps=steps,
+                    cache_hits=cache_hits,
+                )
+            entry, states = calls.pop()
+            linearized &= ~(1 << entry.op.index)
+            n_lin -= 1
+            _unlift(entry)
+            entry = entry.nxt
+
+    order = [e.op.index for e, _ in calls]
+    return CheckResult(
+        CheckOutcome.OK,
+        linearization=order,
+        deepest=order,
+        final_states=list(states),
+        steps=steps,
+        cache_hits=cache_hits,
+    )
+
+
+def _bits_to_list(bits: int) -> list[int]:
+    out = []
+    i = 0
+    while bits:
+        if bits & 1:
+            out.append(i)
+        bits >>= 1
+        i += 1
+    return out
+
+
+def check_events(events, elide_trivial: bool = True, time_budget_s: float | None = None):
+    """Convenience: decode-prepared events → CheckResult."""
+    from .entries import prepare
+
+    return check(prepare(events, elide_trivial=elide_trivial), time_budget_s=time_budget_s)

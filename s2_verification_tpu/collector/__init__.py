@@ -1,0 +1,3 @@
+from . import collect, fake_s2, workloads
+
+__all__ = ["collect", "fake_s2", "workloads"]

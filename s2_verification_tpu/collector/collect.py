@@ -1,0 +1,112 @@
+"""History collection orchestration: the ``collect-history`` equivalent.
+
+Runs N concurrent workload clients against a stream (the in-process fake S2
+by default — this environment has no network), records every call start and
+finish as JSONL, and flushes deferred indefinite-failure finishes after all
+clients stop.  Mirrors the reference binary's lifecycle
+(rust/s2-verification/src/bin/collect-history.rs:55-201):
+
+1. create/open the stream (idempotent);
+2. if the stream is non-empty, emit a rectifying append (client 0) carrying
+   every existing record's hash so the model can start from tail 0
+   (history.rs:650-679);
+3. spawn clients, single-writer event log;
+4. append deferred indefinite-failure finishes, asserting their kind
+   (collect-history.rs:185-193);
+5. write ``./data/records.<epoch>.jsonl`` and print the path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import time
+from dataclasses import dataclass
+
+from ..utils import events as ev
+from ..utils.hashing import record_hash
+from .fake_s2 import FakeS2Stream, FaultPlan
+from .workloads import Ids, HistorySink, WorkloadConfig, run_client
+
+__all__ = ["CollectConfig", "collect_history", "collect_to_file"]
+
+
+@dataclass
+class CollectConfig:
+    num_concurrent_clients: int = 5
+    num_ops_per_client: int = 100
+    workflow: str = "regular"
+    seed: int = 0
+    faults: FaultPlan | None = None
+    indefinite_failure_backoff_s: float = 0.001
+    max_client_ids: int = 20
+
+
+def initialize_tail(sink: HistorySink, op_id: int, tail: int, hashes: list[int]) -> None:
+    """Spoof a successful append 0→tail for a non-empty starting stream."""
+    if len(hashes) != tail:
+        raise ValueError("rectifying append must cover every record from the head")
+    sink.send(
+        ev.LabeledEvent(
+            ev.AppendStart(num_records=tail, record_hashes=tuple(hashes)),
+            client_id=0,
+            op_id=op_id,
+        )
+    )
+    sink.send(ev.LabeledEvent(ev.AppendSuccess(tail=tail), client_id=0, op_id=op_id))
+
+
+async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent]:
+    sink = HistorySink()
+    ids = Ids()
+
+    # Rectify a non-empty starting stream (collect-history.rs:107-118).
+    # Uses the fault-free setup path, like the reference's retrying setup
+    # client.
+    existing = [record_hash(b) for b in stream.snapshot_bodies()]
+    if existing:
+        initialize_tail(sink, ids.take_op_id(), len(existing), existing)
+
+    wcfg = WorkloadConfig(
+        num_ops=cfg.num_ops_per_client,
+        workflow=cfg.workflow,
+        max_client_ids=cfg.max_client_ids,
+        indefinite_failure_backoff_s=cfg.indefinite_failure_backoff_s,
+    )
+    clients = [
+        run_client(stream, sink, ids, random.Random((cfg.seed << 16) ^ (i + 1)), wcfg)
+        for i in range(cfg.num_concurrent_clients)
+    ]
+    deferred_lists = await asyncio.gather(*clients)
+    for deferred in deferred_lists:
+        for le in deferred:
+            assert isinstance(le.event, ev.AppendIndefiniteFailure)
+            sink.send(le)
+    return sink.events
+
+
+def collect_history(
+    cfg: CollectConfig, stream: FakeS2Stream | None = None
+) -> list[ev.LabeledEvent]:
+    """Collect a history in-memory; returns the full event list."""
+    if stream is None:
+        stream = FakeS2Stream(
+            rng=random.Random(cfg.seed ^ 0x5EED),
+            faults=cfg.faults if cfg.faults is not None else FaultPlan.chaos(),
+        )
+    return asyncio.run(_run(cfg, stream))
+
+
+def collect_to_file(
+    cfg: CollectConfig,
+    stream: FakeS2Stream | None = None,
+    out_dir: str = "./data",
+) -> str:
+    """Collect and write ``<out_dir>/records.<epoch>.jsonl``; returns the path."""
+    events = collect_history(cfg, stream)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"records.{int(time.time())}.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        ev.write_history(events, f)
+    return path

@@ -1,0 +1,3 @@
+from . import stream
+
+__all__ = ["stream"]

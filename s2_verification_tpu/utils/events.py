@@ -231,6 +231,12 @@ def _require_int(obj: object, key: str, ctx: str, u64: bool = False) -> int:
     return v
 
 
+def _opt_int(obj: dict, key: str, ctx: str, u64: bool = False) -> int | None:
+    if obj.get(key) is None:
+        return None
+    return _require_int(obj, key, ctx, u64=u64)
+
+
 def _opt_str(obj: dict, key: str, ctx: str) -> str | None:
     v = obj.get(key)
     if v is None or isinstance(v, str):
@@ -258,12 +264,8 @@ def _decode_start(data: object) -> Start:
                 for h in hashes
             ):
                 raise DecodeError("record_hashes must be a list of u64 integers")
-            num = _require_int(args, "num_records", "Append")
-            match = args.get("match_seq_num")
-            if match is not None and (
-                not isinstance(match, int) or isinstance(match, bool) or match < 0
-            ):
-                raise DecodeError(f"Append: bad match_seq_num {match!r}")
+            num = _require_int(args, "num_records", "Append", u64=True)
+            match = _opt_int(args, "match_seq_num", "Append", u64=True)
             try:
                 return AppendStart(
                     num_records=num,
@@ -291,16 +293,18 @@ def _decode_finish(data: object) -> Finish:
     if isinstance(data, dict):
         if "AppendSuccess" in data:
             body = data["AppendSuccess"]
-            return AppendSuccess(tail=_require_int(body, "tail", "AppendSuccess"))
+            return AppendSuccess(tail=_require_int(body, "tail", "AppendSuccess", u64=True))
         if "ReadSuccess" in data:
             body = data["ReadSuccess"]
             return ReadSuccess(
-                tail=_require_int(body, "tail", "ReadSuccess"),
+                tail=_require_int(body, "tail", "ReadSuccess", u64=True),
                 stream_hash=_require_int(body, "stream_hash", "ReadSuccess", u64=True),
             )
         if "CheckTailSuccess" in data:
             body = data["CheckTailSuccess"]
-            return CheckTailSuccess(tail=_require_int(body, "tail", "CheckTailSuccess"))
+            return CheckTailSuccess(
+                tail=_require_int(body, "tail", "CheckTailSuccess", u64=True)
+            )
     raise DecodeError("unknown finish event format")
 
 
@@ -333,8 +337,8 @@ def iter_history(stream: io.TextIOBase | str) -> Iterator[LabeledEvent]:
     """Decode a stream of concatenated JSON records (JSONL or denser).
 
     Mirrors Go ``json.Decoder`` semantics: values may span or share lines and
-    may be arbitrarily large.  Raises :class:`DecodeError` with the byte
-    offset of the first malformed value.
+    may be arbitrarily large.  Raises :class:`DecodeError` with the character
+    offset (into the decoded text) of the first malformed value.
     """
     if isinstance(stream, str):
         stream = io.StringIO(stream)
@@ -342,6 +346,7 @@ def iter_history(stream: io.TextIOBase | str) -> Iterator[LabeledEvent]:
     buf = ""
     pos = 0  # cursor into buf
     consumed = 0  # chars consumed before buf[0]
+    read_size = 1 << 20
     eof = False
     while True:
         while pos < len(buf) and buf[pos].isspace():
@@ -349,27 +354,36 @@ def iter_history(stream: io.TextIOBase | str) -> Iterator[LabeledEvent]:
         if pos < len(buf):
             try:
                 obj, end = decoder.raw_decode(buf, pos)
-            except json.JSONDecodeError:
-                if not eof:
-                    # Possibly a value truncated at the chunk boundary: compact
-                    # the buffer and read more.
+                read_size = 1 << 20
+            except json.JSONDecodeError as je:
+                # A value truncated at the chunk boundary fails either inside
+                # an unterminated string or within the last partial token;
+                # errors anywhere else are corruption and raised immediately.
+                truncated = je.pos >= len(buf) - 32 or je.msg.startswith(
+                    "Unterminated string"
+                )
+                if truncated and not eof:
+                    # Read exponentially larger chunks so re-parsing a giant
+                    # value costs amortized linear time overall.
                     buf = buf[pos:]
                     consumed += pos
                     pos = 0
-                    chunk = stream.read(1 << 20)
+                    chunk = stream.read(read_size)
+                    read_size = min(read_size * 2, 1 << 28)
                     if chunk:
                         buf += chunk
                     else:
                         eof = True
                     continue
                 raise DecodeError(
-                    f"decode record at offset {consumed + pos}: malformed JSON"
-                )
+                    f"decode record at char offset {consumed + pos}: malformed JSON "
+                    f"({je.msg} at {consumed + je.pos})"
+                ) from None
             try:
                 yield decode_obj(obj)
             except DecodeError as e:
                 raise DecodeError(
-                    f"decode record at offset {consumed + pos}: {e}"
+                    f"decode record at char offset {consumed + pos}: {e}"
                 ) from None
             pos = end
             continue

@@ -1,0 +1,92 @@
+"""Shared test helpers: a compact history builder over the wire vocabulary."""
+
+from s2_verification_tpu.utils.events import (
+    AppendDefiniteFailure,
+    AppendIndefiniteFailure,
+    AppendStart,
+    AppendSuccess,
+    CheckTailFailure,
+    CheckTailStart,
+    CheckTailSuccess,
+    LabeledEvent,
+    ReadFailure,
+    ReadStart,
+    ReadSuccess,
+)
+from s2_verification_tpu.utils.hashing import fold_record_hashes
+
+
+class H:
+    """History builder: explicit call/finish emission for concurrency tests."""
+
+    def __init__(self):
+        self.events: list[LabeledEvent] = []
+        self._next_op = 0
+
+    def _start(self, client, payload):
+        op = self._next_op
+        self._next_op += 1
+        self.events.append(LabeledEvent(payload, client, op))
+        return op
+
+    def call_append(self, client, hashes, set_token=None, token=None, match=None):
+        return self._start(
+            client,
+            AppendStart(
+                num_records=len(hashes),
+                record_hashes=tuple(hashes),
+                set_fencing_token=set_token,
+                fencing_token=token,
+                match_seq_num=match,
+            ),
+        )
+
+    def call_read(self, client):
+        return self._start(client, ReadStart())
+
+    def call_check_tail(self, client):
+        return self._start(client, CheckTailStart())
+
+    def finish(self, client, op, payload):
+        self.events.append(LabeledEvent(payload, client, op))
+
+    # -- sequential conveniences (call + immediate finish) ------------------
+
+    def append_ok(self, client, hashes, tail, **kw):
+        op = self.call_append(client, hashes, **kw)
+        self.finish(client, op, AppendSuccess(tail=tail))
+        return op
+
+    def append_definite_fail(self, client, hashes, **kw):
+        op = self.call_append(client, hashes, **kw)
+        self.finish(client, op, AppendDefiniteFailure())
+        return op
+
+    def append_indefinite_fail(self, client, hashes, **kw):
+        op = self.call_append(client, hashes, **kw)
+        self.finish(client, op, AppendIndefiniteFailure())
+        return op
+
+    def read_ok(self, client, tail, stream_hash):
+        op = self.call_read(client)
+        self.finish(client, op, ReadSuccess(tail=tail, stream_hash=stream_hash))
+        return op
+
+    def read_fail(self, client):
+        op = self.call_read(client)
+        self.finish(client, op, ReadFailure())
+        return op
+
+    def check_tail_ok(self, client, tail):
+        op = self.call_check_tail(client)
+        self.finish(client, op, CheckTailSuccess(tail=tail))
+        return op
+
+    def check_tail_fail(self, client):
+        op = self.call_check_tail(client)
+        self.finish(client, op, CheckTailFailure())
+        return op
+
+
+def fold(hashes, start=0):
+    return fold_record_hashes(start, hashes)

@@ -1,0 +1,121 @@
+"""End-to-end: fake S2 + workload clients produce linearizable histories.
+
+The decisive property: whatever faults are injected, the *true* behavior of
+the fake service is sequential, so every collected history must check OK.
+This is the same invariant Antithesis asserts over the reference harness.
+"""
+
+import random
+
+import pytest
+
+from s2_verification_tpu.checker.oracle import CheckOutcome, check_events
+from s2_verification_tpu.collector.collect import (
+    CollectConfig,
+    collect_history,
+    collect_to_file,
+)
+from s2_verification_tpu.collector.fake_s2 import FakeS2Stream, FaultPlan, _Record
+from s2_verification_tpu.collector.workloads import generate_records
+from s2_verification_tpu.utils import events as ev
+
+
+def cfg(**kw):
+    base = dict(
+        num_concurrent_clients=3,
+        num_ops_per_client=15,
+        seed=7,
+        indefinite_failure_backoff_s=0.0,
+        faults=FaultPlan.chaos(intensity=0.25, max_latency=0.001),
+    )
+    base.update(kw)
+    return CollectConfig(**base)
+
+
+@pytest.mark.parametrize("workflow", ["regular", "match-seq-num", "fencing"])
+def test_collected_history_is_linearizable(workflow):
+    events = collect_history(cfg(workflow=workflow))
+    assert len(events) > 20
+    res = check_events(events)
+    assert res.outcome == CheckOutcome.OK
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_many_seeds_linearizable(seed):
+    events = collect_history(cfg(seed=seed, workflow="match-seq-num"))
+    assert check_events(events).outcome == CheckOutcome.OK
+
+
+def test_deferred_indefinite_finishes_flushed_last():
+    events = collect_history(cfg(seed=3, workflow="match-seq-num"))
+    # Once the first deferred AppendIndefiniteFailure appears, everything
+    # after it must be one too (collect-history.rs:185-193).
+    kinds = [type(e.event).__name__ for e in events]
+    if "AppendIndefiniteFailure" in kinds:
+        first = kinds.index("AppendIndefiniteFailure")
+        assert all(k == "AppendIndefiniteFailure" for k in kinds[first:])
+
+
+def test_fault_classes_all_appear():
+    events = collect_history(
+        cfg(seed=11, num_ops_per_client=40, workflow="match-seq-num")
+    )
+    kinds = {type(e.event).__name__ for e in events}
+    assert "AppendSuccess" in kinds
+    assert "AppendDefiniteFailure" in kinds
+    assert "AppendIndefiniteFailure" in kinds
+
+
+def test_non_empty_stream_gets_rectifying_append():
+    stream = FakeS2Stream(rng=random.Random(1))
+    stream.records.extend([_Record(b"pre1"), _Record(b"pre2")])
+    events = collect_history(cfg(seed=2, faults=FaultPlan()), stream=stream)
+    first = events[0]
+    assert isinstance(first.event, ev.AppendStart)
+    assert first.client_id == 0
+    assert first.event.num_records == 2
+    assert isinstance(events[1].event, ev.AppendSuccess)
+    assert events[1].event.tail == 2
+    assert check_events(events).outcome == CheckOutcome.OK
+
+
+def test_generate_records_respects_batch_budget():
+    rng = random.Random(5)
+    for _ in range(50):
+        bodies, hashes = generate_records(rng, rng.randint(1, 999))
+        assert len(bodies) == len(hashes) >= 1
+        metered = sum(8 + len(b) for b in bodies)
+        assert metered <= 1024 + 8 + 1024  # last record may exceed by its size
+        # Faithful bound: bytes before the last record fit under the cap.
+        assert sum(8 + len(b) for b in bodies[:-1]) < 1024
+
+
+def test_client_rotation_capped():
+    # Indefinite failures on every append: clients rotate ids until the id
+    # budget runs out, then stop early.  (Like the reference, only *rotation*
+    # checks the cap — the initial id take is uncapped, history.rs:190,161-167.)
+    events = collect_history(
+        cfg(
+            seed=9,
+            num_concurrent_clients=4,
+            num_ops_per_client=50,
+            faults=FaultPlan(p_append_indefinite=1.0),
+            max_client_ids=6,
+        )
+    )
+    client_ids = {e.client_id for e in events}
+    # Ids come from one shared counter: 4 initial takes + at most one
+    # successful rotation per id below the cap.
+    assert len(client_ids) <= 4 + 6
+    # Every append failed indefinitely, so every client stopped early.
+    n_ops = len({e.op_id for e in events})
+    assert n_ops < 4 * 50
+    # Each id's ops are sequential and every indefinite finish is deferred.
+    assert check_events(events).outcome == CheckOutcome.OK
+
+
+def test_collect_to_file_roundtrip(tmp_path):
+    path = collect_to_file(cfg(seed=4), out_dir=str(tmp_path))
+    events = ev.read_history(path)
+    assert len(events) > 10
+    assert check_events(events).outcome == CheckOutcome.OK

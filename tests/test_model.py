@@ -1,0 +1,137 @@
+"""Unit tests for the S2 stream model's step truth table (SURVEY.md §2.1,
+golang/s2-porcupine/main.go:264-340)."""
+
+from s2_verification_tpu.models.stream import (
+    APPEND,
+    CHECK_TAIL,
+    INIT_STATE,
+    READ,
+    StreamInput,
+    StreamOutput,
+    StreamState,
+    step,
+    step_set,
+)
+from s2_verification_tpu.utils.hashing import fold_record_hashes
+
+S0 = StreamState(tail=4, stream_hash=77, fencing_token=None)
+ST = StreamState(tail=4, stream_hash=77, fencing_token="tok")
+
+
+def appended(state, hashes, token=None):
+    return StreamState(
+        tail=state.tail + len(hashes),
+        stream_hash=fold_record_hashes(state.stream_hash, hashes),
+        fencing_token=token if token is not None else state.fencing_token,
+    )
+
+
+def ap_in(hashes, set_tok=None, batch_tok=None, match=None):
+    return StreamInput(
+        input_type=APPEND,
+        set_fencing_token=set_tok,
+        batch_fencing_token=batch_tok,
+        match_seq_num=match,
+        num_records=len(hashes),
+        record_hashes=tuple(hashes),
+    )
+
+
+def test_append_success():
+    hs = (11, 22)
+    out = StreamOutput(tail=6)
+    assert step(S0, ap_in(hs), out) == [appended(S0, hs)]
+
+
+def test_append_success_wrong_tail_is_illegal():
+    assert step(S0, ap_in((11, 22)), StreamOutput(tail=7)) == []
+
+
+def test_append_definite_failure_is_noop():
+    out = StreamOutput(failure=True, definite_failure=True)
+    assert step(S0, ap_in((11, 22), match=999), out) == [S0]
+
+
+def test_append_indefinite_failure_forks():
+    out = StreamOutput(failure=True)
+    hs = (11, 22)
+    assert step(S0, ap_in(hs), out) == [appended(S0, hs), S0]
+
+
+def test_append_indefinite_failure_guarded_by_match_seq_num():
+    out = StreamOutput(failure=True)
+    assert step(S0, ap_in((11,), match=3), out) == [S0]  # mismatch: no fork
+    hs = (11,)
+    assert step(S0, ap_in(hs, match=4), out) == [appended(S0, hs), S0]
+
+
+def test_append_indefinite_failure_guarded_by_token():
+    out = StreamOutput(failure=True)
+    # No token on the stream: supplied batch token cannot match.
+    assert step(S0, ap_in((11,), batch_tok="tok"), out) == [S0]
+    # Matching token: fork.
+    hs = (11,)
+    assert step(ST, ap_in(hs, batch_tok="tok"), out) == [appended(ST, hs), ST]
+    # Mismatching token: no fork.
+    assert step(ST, ap_in((11,), batch_tok="other"), out) == [ST]
+
+
+def test_append_success_guards():
+    # Success with a mismatched token or seq num is an illegal observation.
+    assert step(S0, ap_in((11,), batch_tok="tok"), StreamOutput(tail=5)) == []
+    assert step(ST, ap_in((11,), batch_tok="other"), StreamOutput(tail=5)) == []
+    assert step(S0, ap_in((11,), match=3), StreamOutput(tail=5)) == []
+    hs = (11,)
+    assert step(ST, ap_in(hs, batch_tok="tok"), StreamOutput(tail=5)) == [appended(ST, hs)]
+
+
+def test_append_sets_fencing_token():
+    hs = (99,)
+    got = step(S0, ap_in(hs, set_tok="new"), StreamOutput(tail=5))
+    assert got == [appended(S0, hs, token="new")]
+    # Setting a token on a fenced stream requires the batch token to match
+    # only if one was supplied; set alone replaces it.
+    got = step(ST, ap_in(hs, set_tok="new"), StreamOutput(tail=5))
+    assert got == [appended(ST, hs, token="new")]
+
+
+def test_empty_string_token_distinct_from_none():
+    s_empty = StreamState(4, 77, "")
+    out = StreamOutput(failure=True)
+    # none-token stream vs "" batch token: mismatch (Go nil vs pointer-to-"").
+    assert step(S0, ap_in((1,), batch_tok=""), out) == [S0]
+    hs = (1,)
+    assert step(s_empty, ap_in(hs, batch_tok=""), out) == [appended(s_empty, hs), s_empty]
+
+
+def test_read_checks_hash_and_tail():
+    rd = StreamInput(input_type=READ)
+    assert step(S0, rd, StreamOutput(tail=4, stream_hash=77)) == [S0]
+    assert step(S0, rd, StreamOutput(tail=4, stream_hash=78)) == []
+    assert step(S0, rd, StreamOutput(tail=5, stream_hash=77)) == []
+    assert step(S0, rd, StreamOutput(failure=True, definite_failure=True)) == [S0]
+
+
+def test_check_tail():
+    ct = StreamInput(input_type=CHECK_TAIL)
+    assert step(S0, ct, StreamOutput(tail=4)) == [S0]
+    assert step(S0, ct, StreamOutput(tail=3)) == []
+    assert step(S0, ct, StreamOutput(failure=True, definite_failure=True)) == [S0]
+
+
+def test_step_set_unions_and_dedups():
+    out = StreamOutput(failure=True)
+    hs = (11,)
+    forked = step_set([S0], ap_in(hs), out)
+    assert forked == [appended(S0, hs), S0]
+    # Stepping the forked set through a check-tail success filters it.
+    ct = StreamInput(input_type=CHECK_TAIL)
+    assert step_set(forked, ct, StreamOutput(tail=4)) == [S0]
+    assert step_set(forked, ct, StreamOutput(tail=5)) == [appended(S0, hs)]
+    # Dedup: two identical paths collapse.
+    dup = step_set([S0, S0], ct, StreamOutput(tail=4))
+    assert dup == [S0]
+
+
+def test_init_state():
+    assert INIT_STATE == StreamState(0, 0, None)

@@ -1,0 +1,284 @@
+"""Semantic conformance for the CPU oracle checker.
+
+Re-expresses the reference's Go model tests (golang/s2-porcupine/main_test.go)
+through the full wire path (events → prepare → check), plus concurrency,
+open-op, fencing, and trivial-op-elision cases the reference exercises only
+in production.
+"""
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.checker.entries import HistoryError, prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check, check_events
+from s2_verification_tpu.utils.events import (
+    AppendStart,
+    AppendSuccess,
+    ReadSuccess,
+)
+
+BATCH1 = [11, 22, 33, 44]
+BATCH2 = [55, 66, 77, 88, 99]
+H1 = fold(BATCH1)
+H2 = fold(BATCH2, start=H1)
+
+
+def outcome(h, **kw):
+    return check_events(h.events, **kw).outcome
+
+
+def test_basic_no_concurrency():
+    # main_test.go:128-152
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    h.check_tail_ok(0, tail=4)
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_definite_failure_has_no_effect():
+    # main_test.go:154-191
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    h.check_tail_ok(0, tail=4)
+    h.append_definite_fail(0, BATCH2)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_definite_failure_observed_as_applied_is_illegal():
+    # main_test.go:192-232: the later read implies the definitely-failed
+    # append took effect.
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    h.check_tail_ok(0, tail=4)
+    h.append_definite_fail(0, BATCH2)
+    h.read_ok(0, tail=9, stream_hash=H2)
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_indefinite_failure_may_apply():
+    # main_test.go:233-272
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    h.check_tail_ok(0, tail=4)
+    h.append_indefinite_fail(0, BATCH2)
+    h.read_ok(0, tail=9, stream_hash=H2)
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_indefinite_failure_may_not_apply():
+    # main_test.go:273-311
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    h.check_tail_ok(0, tail=4)
+    h.append_indefinite_fail(0, BATCH2)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_read_detects_corrupted_prefix():
+    # main_test.go:317-342: right tail, right last batch, wrong prefix.
+    h = H()
+    h.append_ok(0, [11, 22], tail=2)
+    h.append_ok(0, [33], tail=3)
+    h_corrupt = fold([33], start=fold([98, 99]))
+    h.read_ok(0, tail=3, stream_hash=h_corrupt)
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_read_verifies_whole_stream():
+    # main_test.go:346-368
+    h = H()
+    h.append_ok(0, [11, 22], tail=2)
+    h.append_ok(0, [33], tail=3)
+    h.read_ok(0, tail=3, stream_hash=fold([33], start=fold([11, 22])))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_large_history_line_checks_ok():
+    # main_test.go:34-101: 5000-record append then read.
+    n = 5000
+    hashes = [(2**64 - 1) - i for i in range(n)]
+    h = H()
+    h.append_ok(0, hashes, tail=n)
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_empty_history_is_ok():
+    assert check_events([]).outcome == CheckOutcome.OK
+
+
+# ---------------------------------------------------------------------------
+# Concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_appends_commute():
+    # Two clients' appends overlap; the reported tails force an order
+    # opposite to call order.
+    a, b = [1, 2], [3]
+    h = H()
+    op_a = h.call_append(1, a)  # called first...
+    op_b = h.call_append(2, b)
+    h.finish(2, op_b, AppendSuccess(tail=1))  # ...but b linearizes first
+    h.finish(1, op_a, AppendSuccess(tail=3))
+    h.read_ok(1, tail=3, stream_hash=fold(a, start=fold(b)))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_non_overlapping_appends_cannot_reorder():
+    # Same tails, but the ops do NOT overlap: b completes before a starts,
+    # yet the tails imply b linearized first while a's call is later. That's
+    # consistent; the reverse (a first) is not.
+    a, b = [1, 2], [3]
+    h = H()
+    h.append_ok(1, a, tail=3)  # a fully precedes b but claims the later range
+    h.append_ok(2, b, tail=1)  # b claims the earlier range -> impossible
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_concurrent_read_sees_either_side():
+    h = H()
+    op_a = h.call_append(1, [5])
+    op_r = h.call_read(2)
+    h.finish(2, op_r, ReadSuccess(tail=0, stream_hash=0))  # read before append
+    h.finish(1, op_a, AppendSuccess(tail=1))
+    assert outcome(h) == CheckOutcome.OK
+
+    h = H()
+    op_a = h.call_append(1, [5])
+    op_r = h.call_read(2)
+    h.finish(2, op_r, ReadSuccess(tail=1, stream_hash=fold([5])))
+    h.finish(1, op_a, AppendSuccess(tail=1))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_stale_read_after_return_is_illegal():
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    h.read_ok(2, tail=0, stream_hash=0)  # reads empty after append returned
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_open_op_takes_effect_late():
+    # An indefinite append whose finish never arrives (client crashed): the
+    # op stays open and may linearize after anything, including after ops
+    # that started later.
+    h = H()
+    op_open = h.call_append(1, [7])  # no finish ever
+    h.append_ok(2, [8], tail=1)
+    h.read_ok(2, tail=2, stream_hash=fold([7], start=fold([8])))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_open_op_need_not_take_effect():
+    h = H()
+    h.call_append(1, [7])  # no finish
+    h.append_ok(2, [8], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([8]))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_deferred_indefinite_finish_after_all_clients():
+    # The collector flushes deferred AppendIndefiniteFailure finishes after
+    # all clients stop (collect-history.rs:185-193): the op's window spans
+    # the whole tail of the history.
+    h = H()
+    op_i = h.call_append(1, [7])
+    h.append_ok(2, [8], tail=1)
+    h.read_ok(2, tail=2, stream_hash=fold([7], start=fold([8])))
+    from s2_verification_tpu.utils.events import AppendIndefiniteFailure
+
+    h.finish(1, op_i, AppendIndefiniteFailure())
+    assert outcome(h) == CheckOutcome.OK
+
+
+# ---------------------------------------------------------------------------
+# Fencing / match_seq_num end-to-end
+# ---------------------------------------------------------------------------
+
+
+def test_fencing_token_lifecycle():
+    tok_hash = 12345
+    h = H()
+    h.append_ok(1, [tok_hash], tail=1, set_token="tok", match=0)  # fence
+    h.append_ok(1, [50], tail=2, token="tok")  # guarded append, token matches
+    h.read_ok(2, tail=2, stream_hash=fold([50], start=fold([tok_hash])))
+    assert outcome(h) == CheckOutcome.OK
+
+
+def test_fenced_append_with_wrong_token_cannot_succeed():
+    tok_hash = 12345
+    h = H()
+    h.append_ok(1, [tok_hash], tail=1, set_token="tok", match=0)
+    h.append_ok(2, [50], tail=2, token="other")  # wrong token yet succeeded
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_match_seq_num_success_requires_matching_tail():
+    h = H()
+    h.append_ok(1, [1, 2], tail=2)
+    h.append_ok(1, [3], tail=3, match=1)  # claims success at seq 1: impossible
+    assert outcome(h) == CheckOutcome.ILLEGAL
+
+
+def test_match_seq_num_race_definite_failure():
+    # Two clients guard on the same expected seq; one wins, one definitely
+    # fails — the classic match-seq-num race the workflow is built to create.
+    h = H()
+    a = h.call_append(1, [1], match=0)
+    b = h.call_append(2, [2], match=0)
+    h.finish(1, a, AppendSuccess(tail=1))
+    from s2_verification_tpu.utils.events import AppendDefiniteFailure
+
+    h.finish(2, b, AppendDefiniteFailure())
+    h.read_ok(1, tail=1, stream_hash=fold([1]))
+    assert outcome(h) == CheckOutcome.OK
+
+
+# ---------------------------------------------------------------------------
+# Preparation / elision
+# ---------------------------------------------------------------------------
+
+
+def test_trivial_elision_equivalence():
+    # Histories heavy in definite failures: elided and non-elided agree.
+    h = H()
+    h.append_ok(1, [1], tail=1)
+    for _ in range(5):
+        h.append_definite_fail(1, [9], match=99)
+        h.read_fail(2)
+        h.check_tail_fail(2)
+    h.read_ok(2, tail=1, stream_hash=fold([1]))
+    r1 = check_events(h.events, elide_trivial=True)
+    r2 = check_events(h.events, elide_trivial=False)
+    assert r1.outcome == r2.outcome == CheckOutcome.OK
+    hist = prepare(h.events)
+    assert len(hist.trivial_ops) == 15
+    assert hist.num_ops == 2
+
+
+def test_overlapping_ops_within_client_rejected():
+    h = H()
+    op1 = h.call_read(1)
+    h.call_read(1)  # same client, first op still open
+    with pytest.raises(HistoryError, match="sequential"):
+        prepare(h.events)
+
+
+def test_linearization_order_is_reported():
+    h = H()
+    h.append_ok(0, BATCH1, tail=4)
+    h.read_ok(0, tail=4, stream_hash=H1)
+    res = check_events(h.events)
+    assert res.ok
+    assert res.linearization is not None
+    hist = prepare(h.events)
+    # Order must be consistent: append before read here.
+    kinds = [hist.ops[i].inp.input_type for i in res.linearization]
+    assert kinds == [0, 1]

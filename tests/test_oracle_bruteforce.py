@@ -1,0 +1,140 @@
+"""Differential validation of the DFS oracle against a brute-force checker.
+
+The brute-force checker enumerates every total order of ops consistent with
+real time and replays the model — exponential, but independent of the DFS
+machinery (no entry list, no memoization, no elision). Random small histories
+generated from a toy replayable stream keep both sides honest.
+"""
+
+import itertools
+import random
+
+from helpers import H, fold
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.models.stream import INIT_STATE, step_set
+
+
+def brute_force_ok(history) -> bool:
+    ops = history.ops
+    n = len(ops)
+    if n == 0:
+        return True
+
+    def consistent(order):
+        # later-called op may not precede an op that returned before its call
+        pos = {j: k for k, j in enumerate(order)}
+        for a in ops:
+            for b in ops:
+                if a.ret < b.call and pos[a.index] > pos[b.index]:
+                    return False
+        return True
+
+    for order in itertools.permutations(range(n)):
+        if not consistent(order):
+            continue
+        states = [INIT_STATE]
+        for j in order:
+            states = step_set(states, ops[j].inp, ops[j].out)
+            if not states:
+                break
+        if states:
+            return True
+    return False
+
+
+def random_history(rng: random.Random) -> H:
+    """A small random concurrent history over a simulated stream.
+
+    Ops are issued by 2-3 clients with random interleaving of call/finish;
+    outputs are produced by a real sequential stream applied at finish time,
+    with random lies injected so both OK and ILLEGAL cases appear.
+    """
+    h = H()
+    n_clients = rng.randint(2, 3)
+    stream: list[int] = []
+    open_ops: list[tuple[int, int, str, list[int], int | None]] = []
+    next_hash = 100
+    for _ in range(rng.randint(3, 6)):
+        if open_ops and (rng.random() < 0.5 or len(open_ops) == n_clients):
+            # Finish a random open op; apply it to the stream now.
+            i = rng.randrange(len(open_ops))
+            client, op, kind, hashes, match = open_ops.pop(i)
+            lie = rng.random() < 0.15
+            if kind == "append":
+                applies = match is None or match == len(stream)
+                if rng.random() < 0.2:
+                    from s2_verification_tpu.utils.events import (
+                        AppendIndefiniteFailure,
+                    )
+
+                    if applies and rng.random() < 0.5:
+                        stream.extend(hashes)
+                    h.finish(client, op, AppendIndefiniteFailure())
+                elif applies or lie:
+                    from s2_verification_tpu.utils.events import AppendSuccess
+
+                    if applies:
+                        stream.extend(hashes)
+                    tail = len(stream) + (1 if lie and rng.random() < 0.5 else 0)
+                    h.finish(client, op, AppendSuccess(tail=tail))
+                else:
+                    from s2_verification_tpu.utils.events import (
+                        AppendDefiniteFailure,
+                    )
+
+                    h.finish(client, op, AppendDefiniteFailure())
+            elif kind == "read":
+                from s2_verification_tpu.utils.events import ReadSuccess
+
+                sh = fold(stream)
+                if lie:
+                    sh ^= 0xBAD
+                h.finish(client, op, ReadSuccess(tail=len(stream), stream_hash=sh))
+            else:
+                from s2_verification_tpu.utils.events import CheckTailSuccess
+
+                tail = len(stream) + (1 if lie else 0)
+                h.finish(client, op, CheckTailSuccess(tail=tail))
+        else:
+            # Start a new op on an idle client.
+            busy = {c for c, *_ in open_ops}
+            free = [c for c in range(1, n_clients + 1) if c not in busy]
+            if not free:
+                continue
+            client = rng.choice(free)
+            kind = rng.choice(["append", "append", "read", "check_tail"])
+            if kind == "append":
+                hashes = [next_hash + k for k in range(rng.randint(1, 3))]
+                next_hash += 10
+                match = len(stream) if rng.random() < 0.4 else None
+                op = h.call_append(client, hashes, match=match)
+                open_ops.append((client, op, kind, hashes, match))
+            elif kind == "read":
+                op = h.call_read(client)
+                open_ops.append((client, op, kind, [], None))
+            else:
+                op = h.call_check_tail(client)
+                open_ops.append((client, op, kind, [], None))
+    # Any still-open ops stay pending (open-op path).
+    return h
+
+
+def test_dfs_matches_brute_force_on_random_histories():
+    rng = random.Random(0xC0FFEE)
+    n_ok = n_bad = 0
+    for trial in range(300):
+        h = random_history(rng)
+        hist_full = prepare(h.events, elide_trivial=False)
+        if hist_full.num_ops > 7:
+            continue
+        expect = brute_force_ok(hist_full)
+        got_plain = check(hist_full).outcome
+        got_elided = check(prepare(h.events, elide_trivial=True)).outcome
+        want = CheckOutcome.OK if expect else CheckOutcome.ILLEGAL
+        assert got_plain == want, f"trial {trial}: DFS={got_plain} brute={want}"
+        assert got_elided == want, f"trial {trial}: elided DFS diverged"
+        n_ok += expect
+        n_bad += not expect
+    # The generator must actually produce both classes.
+    assert n_ok > 20 and n_bad > 20, (n_ok, n_bad)
