@@ -92,13 +92,13 @@ class History:
 def _collect_ops(events: list[ev.LabeledEvent]) -> list[Op]:
     calls: dict[int, tuple[int, int, StreamInput]] = {}  # op_id -> (time, client, inp)
     finished: list[Op] = []
-    order: dict[int, int] = {}  # op_id -> arrival order for stable indexing
+    seen_op_ids: set[int] = set()
     for time, le in enumerate(events):
         if le.is_start:
-            if le.op_id in calls or le.op_id in order:
+            if le.op_id in seen_op_ids:
                 raise HistoryError(f"duplicate call for op_id {le.op_id}")
             calls[le.op_id] = (time, le.client_id, input_from_start(le.event))
-            order[le.op_id] = len(order)
+            seen_op_ids.add(le.op_id)
         else:
             pending = calls.pop(le.op_id, None)
             if pending is None:

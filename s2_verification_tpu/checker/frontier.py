@@ -1,0 +1,260 @@
+"""Frontier (breadth-first) linearizability search — host reference.
+
+This is the algorithm the TPU backend executes, in plain python, used both as
+its differential oracle and as the place the invariants are documented.  It
+is DFS-equivalent in verdict (explores the same reachable configuration
+graph as the Wing–Gong search in checker/oracle.py) but executes layer by
+layer so every step is a dense, batched map over a *frontier* — the shape
+that vmaps onto a TPU and shards over a mesh.
+
+Key structural facts it exploits (see checker/entries.py):
+
+- Ops within a chain (client id) are sequential, so a configuration's
+  linearized set is one prefix counter per chain — no op bitset.
+- A configuration is ``(counts, state-set)``; two configurations with equal
+  counts and equal state sets have identical futures, so layers dedup on
+  exactly that pair (the frontier twin of Lowe's memoization).
+- Candidate rule: chain c's next op j can linearize iff ``call[j] < m`` where
+  ``m`` is the minimum return time over *all* unlinearized ops — and since
+  returns are increasing within a chain, ``m`` is the min over chains of the
+  next op's return.
+- BFS layers are exhaustive: every linearization has length N, success iff
+  some configuration completes all chains, failure iff a layer is empty.
+
+**Auto-close** (an optimization the reference's Porcupine search lacks):
+an indefinite-failure append whose effect branch is *dead forever* — its
+``match_seq_num`` is below every candidate state's tail (tails are
+monotone), or its fencing token can no longer match (no remaining op sets
+it) — steps every state to itself.  Linearizing it immediately, without
+forking a child, is sound (nothing is lost: its only surviving branch
+changes no state) and complete (it must be linearized eventually and the
+position no longer matters).  Without this, the open ops left behind by
+client rotation multiply candidate positions combinatorially — this is
+precisely what makes adversarial histories CPU-intractable for Porcupine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..models.stream import APPEND, StreamState, step_set
+from .entries import History, Op
+from .oracle import CheckOutcome, CheckResult
+
+__all__ = ["check_frontier", "check_frontier_auto", "FrontierStats"]
+
+
+@dataclass
+class FrontierStats:
+    layers: int = 0
+    max_frontier: int = 0
+    max_state_set: int = 0
+    auto_closed: int = 0
+    expanded: int = 0
+    pruned: bool = False
+
+
+def _op_dead_forever(
+    op: Op, states: frozenset[StreamState], settable_tokens: frozenset[str]
+) -> bool:
+    """True if an indefinite append's effect branch can never fire again."""
+    if not op.is_indefinite_append:
+        return False
+    inp = op.inp
+    if inp.match_seq_num is not None:
+        # Tails are monotone along every path; once every candidate state's
+        # tail has passed the guard, the effect can never apply.
+        if all(s.tail > inp.match_seq_num for s in states):
+            return True
+    if inp.batch_fencing_token is not None:
+        token = inp.batch_fencing_token
+        if all(s.fencing_token != token for s in states) and token not in settable_tokens:
+            return True
+    return False
+
+
+def check_frontier(
+    history: History,
+    auto_close: bool = True,
+    max_frontier: int | None = None,
+    beam: bool = False,
+    collect_stats: bool = False,
+) -> CheckResult:
+    """Decide linearizability by frontier BFS.  Verdict matches the DFS.
+
+    With ``beam=True``, layers exceeding ``max_frontier`` are *pruned* to the
+    best configurations (fewest linearized indefinite appends — the lazy
+    order — then deterministic hash) instead of aborting.  An OK under
+    pruning is still sound (any accepting path proves linearizability); a
+    dead end after pruning is inconclusive and reported UNKNOWN — callers
+    escalate to an exhaustive pass (see :func:`check_frontier_auto`).
+    """
+    ops = history.ops
+    chains = history.chains
+    n_chains = len(chains)
+    stats = FrontierStats()
+
+    if not ops:
+        from ..models.stream import INIT_STATE
+
+        return CheckResult(CheckOutcome.OK, linearization=[], final_states=[INIT_STATE])
+
+    settable_tokens = frozenset(
+        op.inp.set_fencing_token
+        for op in ops
+        if op.inp.input_type == APPEND and op.inp.set_fencing_token is not None
+    )
+
+    from ..models.stream import INIT_STATE
+
+    init_counts = tuple(0 for _ in range(n_chains))
+    frontier: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {
+        (init_counts, frozenset([INIT_STATE])): None
+    }
+    target = tuple(len(c) for c in chains)
+
+    # Per-chain prefix counts of indefinite appends, for the relaxed
+    # acceptance test and the lazy beam ranking.
+    open_prefix = [
+        [0] * (len(chain) + 1) for chain in chains
+    ]
+    for c, chain in enumerate(chains):
+        for k, op_index in enumerate(chain):
+            open_prefix[c][k + 1] = open_prefix[c][k] + int(
+                ops[op_index].is_indefinite_append
+            )
+
+    def accepting(counts) -> bool:
+        """All remaining ops are indefinite appends.
+
+        Such ops step every non-empty state set to a non-empty superset-or-
+        self, and once only they remain every one of them is a candidate, so
+        they can be linearized in any order — the configuration is accepted
+        without materializing those 2^(remaining) layers.
+        """
+        for c in range(n_chains):
+            remaining = len(chains[c]) - counts[c]
+            if remaining and (
+                open_prefix[c][-1] - open_prefix[c][counts[c]] != remaining
+            ):
+                return False
+        return True
+
+    def opens_taken(counts) -> int:
+        return sum(open_prefix[c][counts[c]] for c in range(n_chains))
+
+    def next_op(counts, c) -> Op | None:
+        if counts[c] >= len(chains[c]):
+            return None
+        return ops[chains[c][counts[c]]]
+
+    def window(counts) -> tuple[int, list[int]]:
+        """(m, candidate chains) for a configuration."""
+        m = None
+        for c in range(n_chains):
+            op = next_op(counts, c)
+            if op is not None and (m is None or op.ret < m):
+                m = op.ret
+        cands = []
+        for c in range(n_chains):
+            op = next_op(counts, c)
+            if op is not None and op.call < m:
+                cands.append(c)
+        return m, cands
+
+    def auto_close_config(counts, states):
+        if not auto_close:
+            return counts, states
+        counts = list(counts)
+        changed = True
+        while changed:
+            changed = False
+            _, cands = window(tuple(counts))
+            for c in cands:
+                op = next_op(tuple(counts), c)
+                if _op_dead_forever(op, states, settable_tokens):
+                    counts[c] += 1
+                    stats.auto_closed += 1
+                    changed = True
+        return tuple(counts), states
+
+    layer = 0
+    while True:
+        layer += 1
+        stats.layers = layer
+        stats.max_frontier = max(stats.max_frontier, len(frontier))
+
+        closed: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
+        for counts, states in frontier:
+            counts, states = auto_close_config(counts, states)
+            closed[(counts, states)] = None
+
+        for counts, states in closed:
+            if accepting(counts):
+                stats.max_state_set = max(stats.max_state_set, len(states))
+                res = CheckResult(
+                    CheckOutcome.OK, linearization=None, final_states=sorted(states)
+                )
+                if collect_stats:
+                    res.stats = stats  # type: ignore[attr-defined]
+                return res
+
+        children: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
+        for counts, states in closed:
+            _, cands = window(counts)
+            for c in cands:
+                op = next_op(counts, c)
+                new_states = step_set(sorted(states), op.inp, op.out)
+                stats.expanded += 1
+                if not new_states:
+                    continue
+                stats.max_state_set = max(stats.max_state_set, len(new_states))
+                child_counts = counts[:c] + (counts[c] + 1,) + counts[c + 1 :]
+                children[(child_counts, frozenset(new_states))] = None
+
+        if not children:
+            outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
+            res = CheckResult(outcome)
+            if collect_stats:
+                res.stats = stats  # type: ignore[attr-defined]
+            return res
+        if max_frontier is not None and len(children) > max_frontier:
+            if not beam:
+                res = CheckResult(CheckOutcome.UNKNOWN)
+                if collect_stats:
+                    res.stats = stats  # type: ignore[attr-defined]
+                return res
+            stats.pruned = True
+            ranked = sorted(
+                children, key=lambda cfg: (opens_taken(cfg[0]), hash(cfg))
+            )
+            children = dict.fromkeys(ranked[:max_frontier])
+        frontier = children
+
+
+def check_frontier_auto(
+    history: History,
+    beam_width: int = 4096,
+    exhaustive_cap: int | None = None,
+    collect_stats: bool = False,
+) -> CheckResult:
+    """Beam-first frontier check with exhaustive escalation.
+
+    Phase 1 runs a pruned (beam) search: fast, and an OK is conclusive.
+    Only if the beam dead-ends after pruning does phase 2 re-run without a
+    beam — the porcupine-equivalent exhaustive search (optionally bounded by
+    ``exhaustive_cap``, beyond which the result is UNKNOWN).
+    """
+    res = check_frontier(
+        history,
+        max_frontier=beam_width,
+        beam=True,
+        collect_stats=collect_stats,
+    )
+    if res.outcome != CheckOutcome.UNKNOWN:
+        return res
+    return check_frontier(
+        history,
+        max_frontier=exhaustive_cap,
+        collect_stats=collect_stats,
+    )

@@ -106,7 +106,16 @@ def collect_to_file(
     """Collect and write ``<out_dir>/records.<epoch>.jsonl``; returns the path."""
     events = collect_history(cfg, stream)
     os.makedirs(out_dir, exist_ok=True)
-    path = os.path.join(out_dir, f"records.{int(time.time())}.jsonl")
-    with open(path, "a", encoding="utf-8") as f:
-        ev.write_history(events, f)
-    return path
+    epoch = int(time.time())
+    path = os.path.join(out_dir, f"records.{epoch}.jsonl")
+    suffix = 0
+    while True:
+        try:
+            # Exclusive create: two collections in the same second must not
+            # concatenate into one corrupt history.
+            with open(path, "x", encoding="utf-8") as f:
+                ev.write_history(events, f)
+            return path
+        except FileExistsError:
+            suffix += 1
+            path = os.path.join(out_dir, f"records.{epoch}.{suffix}.jsonl")

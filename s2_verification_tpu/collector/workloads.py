@@ -32,7 +32,7 @@ import string
 from dataclasses import dataclass, field
 
 from ..utils import events as ev
-from ..utils.hashing import record_hash
+from ..utils.hashing import record_hash, stream_hash_of_bodies
 from .fake_s2 import (
     AppendConditionFailed,
     CheckTailError,
@@ -175,12 +175,9 @@ async def _read(ctx: _ClientCtx, client_id: int, op_id: int) -> ev.Finish:
     finish: ev.Finish
     try:
         bodies = await ctx.stream.read_all()
-        acc = 0
-        from ..utils.hashing import chain_hash
-
-        for body in bodies:
-            acc = chain_hash(acc, record_hash(body))
-        finish = ev.ReadSuccess(tail=len(bodies), stream_hash=acc)
+        finish = ev.ReadSuccess(
+            tail=len(bodies), stream_hash=stream_hash_of_bodies(bodies)
+        )
     except ReadError:
         finish = ev.ReadFailure()
     ctx.sink.send(ev.LabeledEvent(finish, client_id, op_id))

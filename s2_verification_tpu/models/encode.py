@@ -1,0 +1,268 @@
+"""Encode a prepared history into fixed-width arrays for the device search.
+
+The reference search walks heap-allocated model values through interface
+dispatch (porcupine's ``Step`` on ``interface{}`` states).  On TPU everything
+becomes dense integer arrays up front:
+
+- one row per op: type, guards, output observation, call/return times, chain;
+- fencing tokens interned to int ids (0 = "no token"; Go's ``nil`` vs ``""``
+  distinction survives because the empty string gets its own nonzero id);
+- ragged per-append record-hash lists packed into one padded uint32-pair
+  matrix, one row per append, with per-op lengths — the device fold masks
+  the padding;
+- chain tables: ops of one client in call order (the linearized set of a
+  sequential client is always a prefix, so a device configuration stores one
+  counter per chain instead of an op bitset).
+
+A **forced prefix** is also precomputed: while the earliest remaining op's
+return precedes every other op's call, that op is alone in its candidate
+window and must linearize first, so the host applies it once and the search
+starts from the resulting state set.  This folds the collector's rectifying
+append (history.rs:650-679) — potentially covering a huge pre-existing
+stream — into the initial state instead of a maximal-width row of the hash
+matrix.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..checker.entries import History, Op
+from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
+
+__all__ = ["EncodedHistory", "encode_history", "INF_TIME"]
+
+INF_TIME = np.int32(2**31 - 1)
+
+
+@dataclass
+class EncodedHistory:
+    """Dense arrays over the N search-relevant ops (after forced-prefix
+    reduction) of a prepared history."""
+
+    # -- per-op input ------------------------------------------------------
+    op_type: np.ndarray  # [N] int32: 0 append, 1 read, 2 check-tail
+    has_set_token: np.ndarray  # [N] bool
+    set_token: np.ndarray  # [N] int32 interned id
+    has_batch_token: np.ndarray  # [N] bool
+    batch_token: np.ndarray  # [N] int32
+    has_match: np.ndarray  # [N] bool
+    match_seq: np.ndarray  # [N] uint32
+    num_records: np.ndarray  # [N] uint32
+    rh_row: np.ndarray  # [N] int32 row into rh matrices (0 for non-appends)
+    rh_len: np.ndarray  # [N] int32
+    # -- per-op output observation ----------------------------------------
+    out_failure: np.ndarray  # [N] bool
+    out_definite: np.ndarray  # [N] bool
+    out_tail: np.ndarray  # [N] uint32 (valid iff not out_failure)
+    out_has_hash: np.ndarray  # [N] bool
+    out_hash_hi: np.ndarray  # [N] uint32
+    out_hash_lo: np.ndarray  # [N] uint32
+    # -- real-time structure ----------------------------------------------
+    call: np.ndarray  # [N] int32
+    ret: np.ndarray  # [N] int32 (INF_TIME for pending ops)
+    chain_of: np.ndarray  # [N] int32
+    # -- record-hash matrix ------------------------------------------------
+    rh_hi: np.ndarray  # [R, L] uint32
+    rh_lo: np.ndarray  # [R, L] uint32
+    # -- chain tables ------------------------------------------------------
+    chain_ops: np.ndarray  # [C, Lc] int32, -1 padded
+    chain_len: np.ndarray  # [C] int32
+    chain_start: np.ndarray  # [C] int32 forced-prefix ops already applied
+    # -- initial state set (post forced-prefix) ----------------------------
+    init_states: list[StreamState]
+    # -- interning ---------------------------------------------------------
+    token_of_id: list[str | None] = field(default_factory=lambda: [None])
+    #: op indices (into History.ops) in forced-prefix order
+    forced_prefix: list[int] = field(default_factory=list)
+
+    @property
+    def num_ops(self) -> int:
+        return int(self.op_type.shape[0])
+
+    @property
+    def num_chains(self) -> int:
+        return int(self.chain_len.shape[0])
+
+    @property
+    def total_remaining(self) -> int:
+        return int((self.chain_len - self.chain_start).sum())
+
+
+def _forced_prefix(history: History) -> tuple[list[int], list[StreamState]]:
+    """Ops that must linearize first, and the state set after applying them.
+
+    An op whose return precedes every other remaining op's call is the only
+    candidate in its window: any valid linearization starts with it.  Applied
+    repeatedly this folds the strictly-sequential prologue of a history
+    (rectifying append, single-client warm-up) into the initial states.
+    """
+    ops = history.ops
+    if not ops:
+        return [], [INIT_STATE]
+    order = sorted(range(len(ops)), key=lambda i: ops[i].call)
+    prefix: list[int] = []
+    states = [INIT_STATE]
+    k = 0
+    while k < len(order):
+        op = ops[order[k]]
+        next_call = ops[order[k + 1]].call if k + 1 < len(order) else None
+        if next_call is not None and op.ret > next_call:
+            break
+        new_states = step_set(states, op.inp, op.out)
+        if not new_states:
+            # Forced op fails: the history is illegal; let the search engine
+            # discover it uniformly by keeping this op unapplied.
+            break
+        states = new_states
+        prefix.append(order[k])
+        k += 1
+    return prefix, states
+
+
+def encode_history(history: History) -> EncodedHistory:
+    forced, init_states = _forced_prefix(history)
+    forced_set = set(forced)
+
+    ops = history.ops
+    keep = [op for op in ops if op.index not in forced_set]
+    n = len(keep)
+
+    tokens: dict[str, int] = {}
+    token_of_id: list[str | None] = [None]
+
+    def intern(tok: str | None) -> int:
+        if tok is None:
+            return 0
+        tid = tokens.get(tok)
+        if tid is None:
+            tid = len(token_of_id)
+            tokens[tok] = tid
+            token_of_id.append(tok)
+        return tid
+
+    op_type = np.zeros(n, np.int32)
+    has_set_token = np.zeros(n, bool)
+    set_token = np.zeros(n, np.int32)
+    has_batch_token = np.zeros(n, bool)
+    batch_token = np.zeros(n, np.int32)
+    has_match = np.zeros(n, bool)
+    match_seq = np.zeros(n, np.uint32)
+    num_records = np.zeros(n, np.uint32)
+    rh_row = np.zeros(n, np.int32)
+    rh_len = np.zeros(n, np.int32)
+    out_failure = np.zeros(n, bool)
+    out_definite = np.zeros(n, bool)
+    out_tail = np.zeros(n, np.uint32)
+    out_has_hash = np.zeros(n, bool)
+    out_hash_hi = np.zeros(n, np.uint32)
+    out_hash_lo = np.zeros(n, np.uint32)
+    call = np.zeros(n, np.int32)
+    ret = np.zeros(n, np.int32)
+
+    append_rows: list[tuple[int, ...]] = []
+    for j, op in enumerate(keep):
+        inp, out = op.inp, op.out
+        op_type[j] = inp.input_type
+        if inp.input_type == APPEND:
+            has_set_token[j] = inp.set_fencing_token is not None
+            set_token[j] = intern(inp.set_fencing_token)
+            has_batch_token[j] = inp.batch_fencing_token is not None
+            batch_token[j] = intern(inp.batch_fencing_token)
+            has_match[j] = inp.match_seq_num is not None
+            match_seq[j] = np.uint32((inp.match_seq_num or 0) & 0xFFFFFFFF)
+            num_records[j] = np.uint32((inp.num_records or 0) & 0xFFFFFFFF)
+            rh_row[j] = len(append_rows)
+            rh_len[j] = len(inp.record_hashes)
+            append_rows.append(inp.record_hashes)
+        out_failure[j] = out.failure
+        out_definite[j] = out.definite_failure
+        out_tail[j] = np.uint32((out.tail or 0) & 0xFFFFFFFF)
+        out_has_hash[j] = out.stream_hash is not None
+        if out.stream_hash is not None:
+            out_hash_hi[j] = np.uint32(out.stream_hash >> 32)
+            out_hash_lo[j] = np.uint32(out.stream_hash & 0xFFFFFFFF)
+        call[j] = op.call
+        ret[j] = INF_TIME if op.pending else op.ret
+
+    r = max(1, len(append_rows))
+    width = max(1, max((len(row) for row in append_rows), default=1))
+    rh_hi = np.zeros((r, width), np.uint32)
+    rh_lo = np.zeros((r, width), np.uint32)
+    for i, row in enumerate(append_rows):
+        arr = np.asarray(row, np.uint64)
+        rh_hi[i, : len(row)] = (arr >> np.uint64(32)).astype(np.uint32)
+        rh_lo[i, : len(row)] = (arr & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+    # Chains over the kept ops (renumbered), preserving History's chain ids.
+    new_index = {op.index: j for j, op in enumerate(keep)}
+    c = len(history.chains)
+    chain_lists: list[list[int]] = [[] for _ in range(c)]
+    chain_of = np.zeros(n, np.int32)
+    for chain_id, members in enumerate(history.chains):
+        for op_index in members:
+            j = new_index.get(op_index)
+            if j is not None:
+                chain_of[j] = chain_id
+                chain_lists[chain_id].append(j)
+    lc = max(1, max((len(m) for m in chain_lists), default=1))
+    chain_ops = np.full((max(1, c), lc), -1, np.int32)
+    chain_len = np.zeros(max(1, c), np.int32)
+    for chain_id, members in enumerate(chain_lists):
+        chain_ops[chain_id, : len(members)] = members
+        chain_len[chain_id] = len(members)
+
+    return EncodedHistory(
+        op_type=op_type,
+        has_set_token=has_set_token,
+        set_token=set_token,
+        has_batch_token=has_batch_token,
+        batch_token=batch_token,
+        has_match=has_match,
+        match_seq=match_seq,
+        num_records=num_records,
+        rh_row=rh_row,
+        rh_len=rh_len,
+        out_failure=out_failure,
+        out_definite=out_definite,
+        out_tail=out_tail,
+        out_has_hash=out_has_hash,
+        out_hash_hi=out_hash_hi,
+        out_hash_lo=out_hash_lo,
+        call=call,
+        ret=ret,
+        chain_of=chain_of,
+        rh_hi=rh_hi,
+        rh_lo=rh_lo,
+        chain_ops=chain_ops,
+        chain_len=chain_len,
+        chain_start=np.zeros(max(1, c), np.int32),
+        init_states=init_states,
+        token_of_id=token_of_id,
+        forced_prefix=forced,
+    )
+
+
+def intern_state(enc: EncodedHistory, state: StreamState) -> tuple[int, int, int, int]:
+    """(tail, hash_hi, hash_lo, token_id) encoding of a model state.
+
+    Token must already be interned; states produced by the forced prefix can
+    only carry tokens that appear as some op's set_fencing_token, which
+    encode_history interned.
+    """
+    if state.fencing_token is None:
+        tid = 0
+    else:
+        try:
+            tid = enc.token_of_id.index(state.fencing_token)
+        except ValueError:
+            tid = len(enc.token_of_id)
+            enc.token_of_id.append(state.fencing_token)
+    return (
+        state.tail & 0xFFFFFFFF,
+        (state.stream_hash >> 32) & 0xFFFFFFFF,
+        state.stream_hash & 0xFFFFFFFF,
+        tid,
+    )

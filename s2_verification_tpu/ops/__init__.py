@@ -1,0 +1,3 @@
+from . import u64, xxh3
+
+__all__ = ["u64", "xxh3"]

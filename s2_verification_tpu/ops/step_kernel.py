@@ -1,0 +1,172 @@
+"""The S2 model's Step as a jit/vmap-compatible array kernel.
+
+Device twin of :func:`s2_verification_tpu.models.stream.step` (itself pinned
+to golang/s2-porcupine/main.go:264-335): one state stepping through one
+observed op yields at most two successor states —
+
+  slot A: the op's "effect" outcome (optimistic state for appends, the
+          unchanged state for reads/check-tails/definite failures);
+  slot B: the "no effect" fork, live only for indefinite append failures.
+
+States are structs of arrays ``(tail u32, hash U64, token i32)``; ops are
+indices into an :class:`~s2_verification_tpu.models.encode.EncodedHistory`
+whose arrays are device-resident.  The chain-hash fold over the op's record
+batch runs as a masked ``lax.scan`` (ops/xxh3.py); everything else is
+branch-free selects, so the whole kernel vmaps over (configurations ×
+candidate ops × candidate states) inside the frontier search.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from . import u64
+from .u64 import U64
+from .xxh3 import fold_record_hashes_masked
+
+__all__ = ["DeviceState", "DeviceOps", "step_kernel", "states_equal"]
+
+
+class DeviceState(NamedTuple):
+    """One model state (or a batch thereof) in device layout."""
+
+    tail: jnp.ndarray  # uint32
+    hash_hi: jnp.ndarray  # uint32
+    hash_lo: jnp.ndarray  # uint32
+    token: jnp.ndarray  # int32; 0 = no token
+
+    @property
+    def stream_hash(self) -> U64:
+        return U64(self.hash_hi, self.hash_lo)
+
+
+class DeviceOps(NamedTuple):
+    """Device-resident columns of an EncodedHistory (one row per op)."""
+
+    op_type: jnp.ndarray
+    has_set_token: jnp.ndarray
+    set_token: jnp.ndarray
+    has_batch_token: jnp.ndarray
+    batch_token: jnp.ndarray
+    has_match: jnp.ndarray
+    match_seq: jnp.ndarray
+    num_records: jnp.ndarray
+    rh_row: jnp.ndarray
+    rh_len: jnp.ndarray
+    out_failure: jnp.ndarray
+    out_definite: jnp.ndarray
+    out_tail: jnp.ndarray
+    out_has_hash: jnp.ndarray
+    out_hash_hi: jnp.ndarray
+    out_hash_lo: jnp.ndarray
+    call: jnp.ndarray
+    ret: jnp.ndarray
+    chain_of: jnp.ndarray
+    rh_hi: jnp.ndarray  # [R, L]
+    rh_lo: jnp.ndarray  # [R, L]
+    chain_ops: jnp.ndarray  # [C, Lc]
+    chain_len: jnp.ndarray  # [C]
+
+    @classmethod
+    def from_encoded(cls, enc) -> "DeviceOps":
+        return cls(
+            op_type=jnp.asarray(enc.op_type),
+            has_set_token=jnp.asarray(enc.has_set_token),
+            set_token=jnp.asarray(enc.set_token),
+            has_batch_token=jnp.asarray(enc.has_batch_token),
+            batch_token=jnp.asarray(enc.batch_token),
+            has_match=jnp.asarray(enc.has_match),
+            match_seq=jnp.asarray(enc.match_seq),
+            num_records=jnp.asarray(enc.num_records),
+            rh_row=jnp.asarray(enc.rh_row),
+            rh_len=jnp.asarray(enc.rh_len),
+            out_failure=jnp.asarray(enc.out_failure),
+            out_definite=jnp.asarray(enc.out_definite),
+            out_tail=jnp.asarray(enc.out_tail),
+            out_has_hash=jnp.asarray(enc.out_has_hash),
+            out_hash_hi=jnp.asarray(enc.out_hash_hi),
+            out_hash_lo=jnp.asarray(enc.out_hash_lo),
+            call=jnp.asarray(enc.call),
+            ret=jnp.asarray(enc.ret),
+            chain_of=jnp.asarray(enc.chain_of),
+            rh_hi=jnp.asarray(enc.rh_hi),
+            rh_lo=jnp.asarray(enc.rh_lo),
+            chain_ops=jnp.asarray(enc.chain_ops),
+            chain_len=jnp.asarray(enc.chain_len),
+        )
+
+
+def states_equal(a: DeviceState, b: DeviceState):
+    return (
+        (a.tail == b.tail)
+        & (a.hash_hi == b.hash_hi)
+        & (a.hash_lo == b.hash_lo)
+        & (a.token == b.token)
+    )
+
+
+def step_kernel(ops: DeviceOps, op_idx, state: DeviceState):
+    """Step one state through op ``op_idx``.
+
+    Returns ``(state_a, valid_a, state_b, valid_b)``; the successor set is
+    {A if valid_a} ∪ {B if valid_b} and the op linearizes here (from this
+    state) iff at least one is valid.
+    """
+    is_append = ops.op_type[op_idx] == 0
+    failure = ops.out_failure[op_idx]
+    definite = ops.out_definite[op_idx]
+
+    # Guards against the current state.
+    token_ok = ~ops.has_batch_token[op_idx] | (state.token == ops.batch_token[op_idx])
+    match_ok = ~ops.has_match[op_idx] | (ops.match_seq[op_idx] == state.tail)
+    guards_ok = token_ok & match_ok
+
+    # Optimistic (applied) successor.  The fold is masked by the op's batch
+    # length; non-append rows fold nothing.
+    width = ops.rh_hi.shape[1]
+    lane = jnp.arange(width)
+    mask = lane < ops.rh_len[op_idx]
+    row = ops.rh_row[op_idx]
+    folded = fold_record_hashes_masked(
+        state.stream_hash, U64(ops.rh_hi[row], ops.rh_lo[row]), mask
+    )
+    opt = DeviceState(
+        tail=state.tail + ops.num_records[op_idx],
+        hash_hi=folded.hi,
+        hash_lo=folded.lo,
+        token=jnp.where(
+            ops.has_set_token[op_idx], ops.set_token[op_idx], state.token
+        ),
+    )
+
+    # Read/check-tail validity: observed hash and tail must match the state.
+    hash_ok = ~ops.out_has_hash[op_idx] | (
+        (state.hash_hi == ops.out_hash_hi[op_idx])
+        & (state.hash_lo == ops.out_hash_lo[op_idx])
+    )
+    rc_keep = hash_ok & (failure | (state.tail == ops.out_tail[op_idx]))
+
+    # Slot A.
+    success_ok = guards_ok & (ops.out_tail[op_idx] == opt.tail)
+    a_is_opt = is_append & ~(failure & definite)
+    valid_a = jnp.where(
+        is_append,
+        jnp.where(
+            failure,
+            jnp.where(definite, True, guards_ok),  # definite: A = state
+            success_ok,
+        ),
+        rc_keep,
+    )
+    state_a = DeviceState(
+        tail=jnp.where(a_is_opt, opt.tail, state.tail),
+        hash_hi=jnp.where(a_is_opt, opt.hash_hi, state.hash_hi),
+        hash_lo=jnp.where(a_is_opt, opt.hash_lo, state.hash_lo),
+        token=jnp.where(a_is_opt, opt.token, state.token),
+    )
+
+    # Slot B: the no-effect fork of an indefinite append failure.
+    valid_b = is_append & failure & ~definite
+    return state_a, valid_a, state, valid_b

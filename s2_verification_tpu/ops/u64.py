@@ -1,0 +1,137 @@
+"""64-bit unsigned integer arithmetic as pairs of uint32, for TPU.
+
+TPU vector units are 32-bit; XLA emulates 64-bit integers, but doing the
+split explicitly keeps every op native, avoids enabling the global
+``jax_enable_x64`` flag (which would change dtype semantics for embedding
+applications), and gives the step kernel full control of the layout.
+
+A :class:`U64` is a pytree of two equal-shaped ``uint32`` arrays ``(hi, lo)``;
+all ops are elementwise and broadcast like jnp primitives, so they compose
+with ``vmap``/``scan``/``shard_map`` transparently.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+__all__ = ["U64", "from_int", "to_ints", "xor", "add", "sub", "mul", "shl", "shr", "rotl", "eq", "select", "full"]
+
+_MASK32 = 0xFFFFFFFF
+
+
+class U64(NamedTuple):
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+
+def from_int(value: int, shape=()) -> U64:
+    """Constant U64 from a python int."""
+    value &= (1 << 64) - 1
+    hi = jnp.full(shape, (value >> 32) & _MASK32, dtype=jnp.uint32)
+    lo = jnp.full(shape, value & _MASK32, dtype=jnp.uint32)
+    return U64(hi, lo)
+
+
+def full(shape, value: int) -> U64:
+    return from_int(value, shape)
+
+
+def from_arrays(hi, lo) -> U64:
+    return U64(jnp.asarray(hi, jnp.uint32), jnp.asarray(lo, jnp.uint32))
+
+
+def to_ints(x: U64):
+    """Device → python ints (host-side, for tests/debug)."""
+    import numpy as np
+
+    hi = np.asarray(x.hi, dtype=np.uint64)
+    lo = np.asarray(x.lo, dtype=np.uint64)
+    return (hi << np.uint64(32)) | lo
+
+
+def xor(a: U64, b: U64) -> U64:
+    return U64(a.hi ^ b.hi, a.lo ^ b.lo)
+
+
+def add(a: U64, b: U64) -> U64:
+    lo = a.lo + b.lo
+    carry = (lo < a.lo).astype(jnp.uint32)
+    return U64(a.hi + b.hi + carry, lo)
+
+
+def sub(a: U64, b: U64) -> U64:
+    borrow = (a.lo < b.lo).astype(jnp.uint32)
+    return U64(a.hi - b.hi - borrow, a.lo - b.lo)
+
+
+def _mul32_hilo(a, b):
+    """Full 32×32→64 product in uint32 pieces (16-bit split)."""
+    ah, al = a >> 16, a & jnp.uint32(0xFFFF)
+    bh, bl = b >> 16, b & jnp.uint32(0xFFFF)
+    p0 = al * bl
+    p1 = al * bh
+    p2 = ah * bl
+    p3 = ah * bh
+    mid = (p0 >> 16) + (p1 & jnp.uint32(0xFFFF)) + (p2 & jnp.uint32(0xFFFF))
+    lo = (mid << 16) | (p0 & jnp.uint32(0xFFFF))
+    hi = p3 + (p1 >> 16) + (p2 >> 16) + (mid >> 16)
+    return hi, lo
+
+
+def mul(a: U64, b: U64) -> U64:
+    """64×64 → low 64 bits."""
+    hi, lo = _mul32_hilo(a.lo, b.lo)
+    hi = hi + a.lo * b.hi + a.hi * b.lo
+    return U64(hi, lo)
+
+
+def shl(a: U64, k: int) -> U64:
+    """Left shift by a static amount 0..63."""
+    k &= 63
+    if k == 0:
+        return a
+    if k < 32:
+        hi = (a.hi << k) | (a.lo >> (32 - k))
+        return U64(hi, a.lo << k)
+    return U64(a.lo << (k - 32) if k > 32 else a.lo, jnp.zeros_like(a.lo))
+
+
+def shr(a: U64, k: int) -> U64:
+    """Logical right shift by a static amount 0..63."""
+    k &= 63
+    if k == 0:
+        return a
+    if k < 32:
+        lo = (a.lo >> k) | (a.hi << (32 - k))
+        return U64(a.hi >> k, lo)
+    return U64(jnp.zeros_like(a.hi), a.hi >> (k - 32) if k > 32 else a.hi)
+
+
+def rotl(a: U64, k: int) -> U64:
+    k &= 63
+    if k == 0:
+        return a
+    left = shl(a, k)
+    right = shr(a, 64 - k)
+    return U64(left.hi | right.hi, left.lo | right.lo)
+
+
+def eq(a: U64, b: U64):
+    return (a.hi == b.hi) & (a.lo == b.lo)
+
+
+def select(pred, a: U64, b: U64) -> U64:
+    return U64(jnp.where(pred, a.hi, b.hi), jnp.where(pred, a.lo, b.lo))
+
+
+def byteswap32(x):
+    """Byte-swap each uint32 lane."""
+    x = jnp.asarray(x, jnp.uint32)
+    return (
+        ((x & jnp.uint32(0xFF)) << 24)
+        | ((x & jnp.uint32(0xFF00)) << 8)
+        | ((x >> 8) & jnp.uint32(0xFF00))
+        | (x >> 24)
+    )

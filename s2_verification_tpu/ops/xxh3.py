@@ -1,0 +1,78 @@
+"""XXH3-64 with seed for 8-byte inputs, as a jit-compatible TPU kernel.
+
+The chain-hash protocol only ever hashes exactly 8 bytes (the little-endian
+encoding of the previous record hash) with the running stream hash as seed
+(utils/hashing.py, reference history.rs:43-45).  That pins the XXH3 code
+path to ``len ∈ [4,8]``:
+
+    seed' = seed XOR (byteswap32(lo32(seed)) << 32)
+    input64 = (lo32(data) << 32) | hi32(data)          # first/last 4 bytes
+    keyed = input64 XOR ((secret[8..16] ^ secret[16..24]) - seed')
+    result = rrmxmx(keyed, len=8)
+
+with rrmxmx the standard avalanche: two rounds of multiply by
+0x9FB21C651E98DF25 with rotate/shift mixing.  The two secret words are
+compile-time constants of the default XXH3 secret.  Bit-exactness against
+the host ``xxhash`` C library is pinned by tests on random values and the
+cross-language chain vectors.
+
+All arithmetic uses the uint32-pair ops in :mod:`.u64`, so the kernel is
+TPU-native (no 64-bit emulation) and composes with vmap/scan/shard_map.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import u64
+from .u64 import U64
+
+__all__ = ["xxh3_8byte_seeded", "chain_hash", "fold_record_hashes_masked"]
+
+# le_u64(secret[8..16]) ^ le_u64(secret[16..24]) of the default XXH3 secret.
+_BITFLIP_BASE = 0x1CAD21F72C81017C ^ 0xDB979083E96DD4DE
+_PRIME_MX2 = 0x9FB21C651E98DF25
+
+
+def _rrmxmx(h: U64, length: int = 8) -> U64:
+    h = u64.xor(h, u64.xor(u64.rotl(h, 49), u64.rotl(h, 24)))
+    h = u64.mul(h, u64.from_int(_PRIME_MX2))
+    h = u64.xor(h, u64.add(u64.shr(h, 35), u64.from_int(length)))
+    h = u64.mul(h, u64.from_int(_PRIME_MX2))
+    h = u64.xor(h, u64.shr(h, 28))
+    return h
+
+
+def xxh3_8byte_seeded(value: U64, seed: U64) -> U64:
+    """XXH3-64(le_bytes(value), seed) — the len==8 specialization."""
+    seed = U64(seed.hi ^ u64.byteswap32(seed.lo), seed.lo)
+    # First 4 LE bytes = lo word, last 4 = hi word; input64 swaps them.
+    input64 = U64(value.lo, value.hi)
+    bitflip = u64.sub(u64.from_int(_BITFLIP_BASE), seed)
+    keyed = u64.xor(input64, bitflip)
+    return _rrmxmx(keyed)
+
+
+def chain_hash(stream_hash: U64, record_hash: U64) -> U64:
+    """Device-side twin of utils.hashing.chain_hash."""
+    return xxh3_8byte_seeded(record_hash, stream_hash)
+
+
+def fold_record_hashes_masked(stream_hash: U64, record_hashes: U64, mask) -> U64:
+    """Left-fold chain_hash over a padded batch of record hashes.
+
+    ``record_hashes`` has one leading axis (the padded batch); ``mask`` is a
+    bool array over that axis — padding lanes leave the accumulator
+    untouched.  Runs as a ``lax.scan`` so the sequential dependency is
+    explicit to XLA; everything else in the search vmaps around it.
+    """
+
+    def step(acc: U64, inp):
+        rh_hi, rh_lo, m = inp
+        nxt = chain_hash(acc, U64(rh_hi, rh_lo))
+        return u64.select(m, nxt, acc), None
+
+    mask = jnp.asarray(mask, bool)
+    acc, _ = lax.scan(step, stream_hash, (record_hashes.hi, record_hashes.lo, mask))
+    return acc

@@ -6,7 +6,10 @@ exercised without TPU hardware.  The env vars must be set before jax imports.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a tunneled
+# TPU, but tests must run on the virtual 8-device CPU mesh.  Set
+# S2VTPU_TEST_PLATFORM to override (e.g. to run the suite on real hardware).
+os.environ["JAX_PLATFORMS"] = os.environ.get("S2VTPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,124 @@
+"""Differential tests: frontier BFS vs the Wing–Gong DFS oracle."""
+
+import random
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier, check_frontier_auto
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from test_oracle_bruteforce import random_history
+
+
+@pytest.mark.parametrize("auto_close", [True, False])
+def test_frontier_matches_dfs_on_random_histories(auto_close):
+    rng = random.Random(0xF00D)
+    agree = 0
+    for trial in range(200):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        want = check(hist).outcome
+        got = check_frontier(hist, auto_close=auto_close).outcome
+        assert got == want, f"trial {trial}: frontier={got} dfs={want}"
+        agree += 1
+    assert agree == 200
+
+
+@pytest.mark.parametrize("workflow", ["regular", "match-seq-num", "fencing"])
+@pytest.mark.parametrize("seed", range(4))
+def test_frontier_on_collected_histories(workflow, seed):
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=30,
+            workflow=workflow,
+            seed=seed,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.3, max_latency=0.001),
+        )
+    )
+    hist = prepare(events)
+    assert check_frontier_auto(hist, beam_width=512).outcome == CheckOutcome.OK
+    assert check(hist).outcome == CheckOutcome.OK
+
+
+def test_frontier_rejects_corrupted_collected_history():
+    from s2_verification_tpu.utils.events import LabeledEvent, ReadSuccess
+
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=20,
+            workflow="regular",
+            seed=1,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.2, max_latency=0.001),
+        )
+    )
+    tampered = []
+    done = False
+    for e in events:
+        if not done and isinstance(e.event, ReadSuccess) and e.event.tail > 0:
+            e = LabeledEvent(
+                ReadSuccess(tail=e.event.tail, stream_hash=e.event.stream_hash ^ 1),
+                e.client_id,
+                e.op_id,
+            )
+            done = True
+        tampered.append(e)
+    assert done
+    hist = prepare(tampered)
+    assert check_frontier(hist).outcome == CheckOutcome.ILLEGAL
+    assert check(hist).outcome == CheckOutcome.ILLEGAL
+
+
+def test_auto_close_handles_many_open_ops():
+    # Open match-seq-num appends whose guards are long dead: without
+    # auto-close the frontier carries every subset of open ops; with it the
+    # search stays narrow.  (This is the CPU-intractable shape of the
+    # reference stress config.)
+    h = H()
+    tail = 0
+    acc = 0
+    # Establish a tail of 3 first, so the opens' guards are stale the moment
+    # they become candidates (every reachable state has tail > match_seq_num).
+    for i in range(3):
+        rh = 200 + i
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    n_open = 10
+    for i in range(n_open):
+        # Each client appends with a dead guard, fails indefinitely, and
+        # never finishes (client rotated away).
+        h.call_append(100 + i, [i + 1], match=i % 3)
+    for i in range(25):
+        rh = 50 + i
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    h.read_ok(2, tail=tail, stream_hash=acc)
+    hist = prepare(h.events)
+    res = check_frontier(hist, collect_stats=True)
+    assert res.outcome == CheckOutcome.OK
+    stats = res.stats
+    assert stats.auto_closed >= n_open
+    # The frontier never needs to branch on the dead opens.
+    assert stats.max_frontier <= 4
+
+    # Sanity: the DFS agrees (it pays a price but these sizes are fine).
+    assert check(hist).outcome == CheckOutcome.OK
+
+
+def test_frontier_unknown_on_budget():
+    # A history with genuinely live ambiguity can exceed a tiny frontier cap.
+    h = H()
+    for i in range(6):
+        h.call_append(10 + i, [i + 1])  # unguarded opens: live forever
+    h.append_ok(1, [99], tail=1)
+    hist = prepare(h.events)
+    res = check_frontier(hist, max_frontier=2)
+    assert res.outcome == CheckOutcome.UNKNOWN

@@ -1,0 +1,157 @@
+"""Differential test: the device Step kernel vs the python model.
+
+Random states × every op of collected histories (all three workflows, with
+fencing tokens, match-seq-num guards, and every failure class) must produce
+identical successor sets.
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.models.encode import encode_history
+from s2_verification_tpu.models.stream import StreamState, step
+from s2_verification_tpu.ops.step_kernel import DeviceOps, DeviceState, step_kernel
+
+
+def collected(workflow, seed=5):
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=25,
+            workflow=workflow,
+            seed=seed,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.3, max_latency=0.001),
+        )
+    )
+    return prepare(events, elide_trivial=False)
+
+
+def random_states(enc, rng, n):
+    """Random device states biased toward values that appear in the history."""
+    tails = [0] + [int(t) for t in enc.out_tail[:20]]
+    hashes = [(0, 0)] + list(zip(enc.out_hash_hi[:20], enc.out_hash_lo[:20]))
+    states = []
+    for _ in range(n):
+        tail = rng.choice(tails) if rng.random() < 0.7 else rng.randrange(2**32)
+        hh, hl = (
+            hashes[rng.randrange(len(hashes))]
+            if rng.random() < 0.7
+            else (rng.randrange(2**32), rng.randrange(2**32))
+        )
+        token = rng.randrange(0, len(enc.token_of_id) + 1)
+        states.append((tail, int(hh), int(hl), token))
+    return states
+
+
+def py_state(enc, dev):
+    tail, hh, hl, tok = dev
+    return StreamState(
+        tail=tail,
+        stream_hash=(hh << 32) | hl,
+        fencing_token=enc.token_of_id[tok] if tok < len(enc.token_of_id) else f"?{tok}",
+    )
+
+
+@pytest.mark.parametrize("workflow", ["regular", "match-seq-num", "fencing"])
+def test_step_kernel_matches_python_model(workflow):
+    hist = collected(workflow)
+    enc = encode_history(hist)
+    if enc.num_ops == 0:
+        pytest.skip("history fully reduced by forced prefix")
+    dev_ops = DeviceOps.from_encoded(enc)
+    rng = random.Random(hash(workflow) & 0xFFFF)
+
+    # Map encoded op rows back to the python Ops they came from.
+    forced = set(enc.forced_prefix)
+    kept = [op for op in hist.ops if op.index not in forced]
+    assert len(kept) == enc.num_ops
+
+    kernel = jax.jit(
+        jax.vmap(
+            jax.vmap(step_kernel, in_axes=(None, None, 0)),  # over states
+            in_axes=(None, 0, None),  # over ops
+        )
+    )
+    states = random_states(enc, rng, 40)
+    dev_states = DeviceState(
+        tail=np.array([s[0] for s in states], np.uint32),
+        hash_hi=np.array([s[1] for s in states], np.uint32),
+        hash_lo=np.array([s[2] for s in states], np.uint32),
+        token=np.array([s[3] for s in states], np.int32),
+    )
+    op_ids = np.arange(enc.num_ops)
+    sa, va, sb, vb = jax.block_until_ready(kernel(dev_ops, op_ids, dev_states))
+    sa = DeviceState(*(np.asarray(x) for x in sa))
+    sb = DeviceState(*(np.asarray(x) for x in sb))
+    va, vb = np.asarray(va), np.asarray(vb)
+
+    def token_name(tok: int):
+        return enc.token_of_id[tok] if tok < len(enc.token_of_id) else f"?{tok}"
+
+    checked = 0
+    for j, op in enumerate(kept):
+        for k, dev in enumerate(states):
+            ps = py_state(enc, dev)
+            want = step(ps, op.inp, op.out)
+            got = []
+            if bool(va[j, k]):
+                got.append(
+                    StreamState(
+                        tail=int(sa.tail[j, k]),
+                        stream_hash=(int(sa.hash_hi[j, k]) << 32) | int(sa.hash_lo[j, k]),
+                        fencing_token=token_name(int(sa.token[j, k])),
+                    )
+                )
+            if bool(vb[j, k]):
+                got.append(
+                    StreamState(
+                        tail=int(sb.tail[j, k]),
+                        stream_hash=(int(sb.hash_hi[j, k]) << 32) | int(sb.hash_lo[j, k]),
+                        fencing_token=token_name(int(sb.token[j, k])),
+                    )
+                )
+            # Order-insensitive compare; the model may fork {opt, state}.
+            assert set(got) == set(want), (
+                f"op {j} ({op.inp.input_type}) state {ps}: kernel={got} model={want}"
+            )
+            checked += 1
+    assert checked >= 40 * len(kept)
+
+
+def test_forced_prefix_reduces_sequential_prologue():
+    # A purely sequential history reduces entirely to the initial state set.
+    from helpers import H, fold
+
+    h = H()
+    h.append_ok(1, [1, 2], tail=2)
+    h.read_ok(1, tail=2, stream_hash=fold([1, 2]))
+    h.check_tail_ok(1, tail=2)
+    hist = prepare(h.events)
+    enc = encode_history(hist)
+    assert enc.num_ops == 0
+    assert len(enc.forced_prefix) == 3
+    assert [s.tail for s in enc.init_states] == [2]
+
+
+def test_forced_prefix_stops_at_concurrency():
+    from helpers import H, fold
+    from s2_verification_tpu.utils.events import AppendSuccess
+
+    h = H()
+    h.append_ok(1, [1], tail=1)  # sequential prologue
+    a = h.call_append(1, [2])  # overlaps with b
+    b = h.call_append(2, [3])
+    h.finish(1, a, AppendSuccess(tail=2))
+    h.finish(2, b, AppendSuccess(tail=3))
+    hist = prepare(h.events)
+    enc = encode_history(hist)
+    assert len(enc.forced_prefix) == 1
+    assert enc.num_ops == 2
+    assert [s.tail for s in enc.init_states] == [1]

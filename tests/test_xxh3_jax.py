@@ -1,0 +1,124 @@
+"""Bit-exactness of the device u64 math and XXH3 kernel vs the host library."""
+
+import random
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import xxhash
+
+from s2_verification_tpu.ops import u64
+from s2_verification_tpu.ops.xxh3 import (
+    chain_hash,
+    fold_record_hashes_masked,
+    xxh3_8byte_seeded,
+)
+from s2_verification_tpu.utils import hashing
+
+M = (1 << 64) - 1
+rng = random.Random(0xABCD)
+
+
+def u(vals):
+    vals = np.asarray(vals, dtype=np.uint64)
+    return u64.U64(
+        jnp.asarray((vals >> np.uint64(32)).astype(np.uint32)),
+        jnp.asarray((vals & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+    )
+
+
+def ints(x):
+    return u64.to_ints(x)
+
+
+def rand64(n):
+    return [rng.getrandbits(64) for _ in range(n)]
+
+
+def test_u64_arith_matches_python():
+    a = rand64(500)
+    b = rand64(500)
+    ua, ub = u(a), u(b)
+    np.testing.assert_array_equal(ints(u64.add(ua, ub)), [(x + y) & M for x, y in zip(a, b)])
+    np.testing.assert_array_equal(ints(u64.sub(ua, ub)), [(x - y) & M for x, y in zip(a, b)])
+    np.testing.assert_array_equal(ints(u64.mul(ua, ub)), [(x * y) & M for x, y in zip(a, b)])
+    np.testing.assert_array_equal(ints(u64.xor(ua, ub)), [x ^ y for x, y in zip(a, b)])
+
+
+def test_u64_shifts_and_rotations():
+    a = rand64(64)
+    ua = u(a)
+    for k in [0, 1, 7, 28, 31, 32, 33, 35, 49, 63]:
+        np.testing.assert_array_equal(ints(u64.shl(ua, k)), [(x << k) & M for x in a])
+        np.testing.assert_array_equal(ints(u64.shr(ua, k)), [x >> k for x in a])
+        np.testing.assert_array_equal(
+            ints(u64.rotl(ua, k)), [((x << k) | (x >> (64 - k))) & M if k else x for x in a]
+        )
+
+
+def test_u64_edge_values():
+    edge = [0, 1, M, M - 1, 1 << 32, (1 << 32) - 1, (1 << 63), 0xFFFFFFFF00000000]
+    pairs = [(x, y) for x in edge for y in edge]
+    ua = u([p[0] for p in pairs])
+    ub = u([p[1] for p in pairs])
+    np.testing.assert_array_equal(ints(u64.add(ua, ub)), [(x + y) & M for x, y in pairs])
+    np.testing.assert_array_equal(ints(u64.mul(ua, ub)), [(x * y) & M for x, y in pairs])
+    np.testing.assert_array_equal(ints(u64.sub(ua, ub)), [(x - y) & M for x, y in pairs])
+
+
+def test_xxh3_bit_exact_vs_host_library():
+    vals = rand64(2000)
+    seeds = [rng.getrandbits(64) if i % 2 else rng.getrandbits(32) for i in range(2000)]
+    got = ints(jax.jit(xxh3_8byte_seeded)(u(vals), u(seeds)))
+    want = [
+        xxhash.xxh3_64_intdigest(struct.pack("<Q", v), seed=s)
+        for v, s in zip(vals, seeds)
+    ]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chain_hash_pinned_vectors():
+    foo = hashing.record_hash(b"foo")
+    h1 = ints(chain_hash(u([0]), u([foo])))[0]
+    h2 = ints(chain_hash(u([h1]), u([hashing.record_hash(b"bar")])))[0]
+    h3 = ints(chain_hash(u([h2]), u([hashing.record_hash(b"baz")])))[0]
+    assert h1 == 0x4D2B003EE417C3A5
+    assert h2 == 0x132E5D5DD7936EDD
+    assert h3 == 0x732EE99ABC5002FF
+
+
+def scalar(value):
+    arr = u([value])
+    return u64.U64(arr.hi[0], arr.lo[0])
+
+
+def test_fold_masked_matches_host():
+    for trial in range(20):
+        n = rng.randint(1, 30)
+        pad = rng.randint(0, 10)
+        hs = rand64(n)
+        start = rng.getrandbits(64)
+        mask = np.array([True] * n + [False] * pad)
+        padded = u(hs + [0] * pad)
+        got = ints(jax.jit(fold_record_hashes_masked)(scalar(start), padded, mask))
+        want = hashing.fold_record_hashes(start, hs)
+        assert int(got) == want, f"trial {trial}"
+
+
+def test_fold_empty_mask_is_identity():
+    padded = u(rand64(8))
+    got = ints(fold_record_hashes_masked(scalar(77), padded, np.zeros(8, bool)))
+    assert int(got) == 77
+
+
+def test_vmapped_fold():
+    # The search folds one batch of hashes from many candidate states.
+    starts = rand64(50)
+    hs = rand64(16)
+    mask = np.array([True] * 12 + [False] * 4)
+    hs_dev = u(hs)
+    batched = jax.vmap(lambda s: fold_record_hashes_masked(s, hs_dev, mask))
+    got = ints(batched(u(starts)))
+    want = [hashing.fold_record_hashes(s, hs[:12]) for s in starts]
+    np.testing.assert_array_equal(got, want)
