@@ -1,3 +1,13 @@
-from . import entries, oracle
+from . import entries, frontier, oracle
 
-__all__ = ["entries", "oracle"]
+__all__ = ["entries", "frontier", "oracle", "device"]
+
+
+def __getattr__(name):
+    # device imports jax; keep it lazy so pure-host users (event decoding,
+    # oracle checking) never pay jax startup.
+    if name == "device":
+        from . import device
+
+        return device
+    raise AttributeError(name)

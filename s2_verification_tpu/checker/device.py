@@ -1,0 +1,676 @@
+"""Device (TPU) frontier linearizability search.
+
+The jit/vmap twin of :mod:`.frontier`: the whole layer-by-layer search runs
+*inside one compiled program* as a ``lax.while_loop`` whose carry is a dense
+frontier of configurations, so there is no per-layer host dispatch.  The
+host's only jobs are encoding the history (models/encode.py), picking
+capacity buckets, and escalating when a run reports it needs a wider
+frontier or state set.
+
+A configuration is ``(counts per chain, canonical candidate-state set)``:
+
+- ``counts  [F, C] int32``  — linearized prefix length of every chain;
+- ``tail/hash_hi/hash_lo/token  [F, S]`` + ``svalid [F, S] bool`` — the
+  state set, canonically sorted (valid first, then by state key) and
+  zeroed in invalid slots so equal sets are bitwise equal;
+- ``valid [F] bool`` — frontier occupancy.
+
+One layer (the while-loop body):
+
+1. **auto-close** — a nested, vmapped ``lax.while_loop`` advances each
+   configuration past indefinite appends whose effect branch is provably
+   dead (guards stale against every candidate state, token never settable)
+   — the device twin of frontier.py's auto-close;
+2. **accept** — a configuration whose remaining ops are all indefinite
+   appends accepts the history (table lookup + reduction);
+3. **expand** — every (configuration × candidate chain × candidate state)
+   triple steps through :func:`~..ops.step_kernel.step_kernel` under two
+   nested ``vmap``s; successor sets are deduped and canonicalized with an
+   O(S²) comparison matrix + ``lexsort`` per child;
+4. **dedup + compact** — children flatten to ``[F*C]`` rows, get a 64-bit
+   mixed hash, and a global ``lexsort`` by (validity, lazy-order rank,
+   hash) brings equal configurations adjacent for exact-compare dedup; a
+   second stable sort compacts survivors into the next frontier.  Layers
+   never revisit earlier configurations (sum(counts) grows by one per
+   layer) so no cross-layer visited set is needed.
+
+Soundness under capacity pressure mirrors the host beam search: an OK is
+always conclusive (every frontier state is genuinely reachable); a dead end
+after any pruning or state-set overflow is UNKNOWN, and the driver
+escalates to the next capacity bucket, resuming from the last intact
+pre-expansion frontier that the compiled program hands back.
+
+Multi-chip: every per-configuration computation is elementwise over the
+frontier axis, so sharding ``F`` over a :class:`jax.sharding.Mesh` makes
+expansion embarrassingly parallel; the dedup sorts become XLA global sorts
+with ICI collectives.  :func:`place_frontier` applies the sharding; the
+driver accepts a ``mesh=`` argument.
+
+Reference parity: the verdict semantics match
+``porcupine.CheckEventsVerbose(model, events, 0)`` as used by
+golang/s2-porcupine/main.go:605-606; the step truth table is
+main.go:264-335 (see ops/step_kernel.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models.encode import INF_TIME, EncodedHistory, encode_history, intern_state
+from ..models.stream import StreamState
+from .entries import History
+from .frontier import FrontierStats
+from .oracle import CheckOutcome, CheckResult
+from ..ops.step_kernel import DeviceOps, DeviceState, step_kernel
+
+__all__ = [
+    "SearchTables",
+    "Frontier",
+    "build_tables",
+    "init_frontier",
+    "run_search",
+    "check_device",
+    "check_device_auto",
+    "place_frontier",
+]
+
+_I32 = jnp.int32
+_U32 = jnp.uint32
+
+
+class SearchTables(NamedTuple):
+    """Device-resident static tables for one encoded history."""
+
+    ops: DeviceOps
+    #: per-op: indefinite append with a match_seq_num guard (auto-close arm 1)
+    ac_match: jnp.ndarray  # [N] bool
+    #: per-op: indefinite append whose batch token is never set by any op
+    ac_tok: jnp.ndarray  # [N] bool
+    #: accept_tab[c, k]: ops k.. of chain c are all indefinite appends
+    accept_tab: jnp.ndarray  # [C, Lc+1] bool
+    #: opens_tab[c, k]: # indefinite appends among the first k ops of chain c
+    opens_tab: jnp.ndarray  # [C, Lc+1] int32
+
+
+class Frontier(NamedTuple):
+    counts: jnp.ndarray  # [F, C] int32
+    tail: jnp.ndarray  # [F, S] uint32
+    hi: jnp.ndarray  # [F, S] uint32
+    lo: jnp.ndarray  # [F, S] uint32
+    tok: jnp.ndarray  # [F, S] int32
+    svalid: jnp.ndarray  # [F, S] bool
+    valid: jnp.ndarray  # [F] bool
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    @property
+    def state_slots(self) -> int:
+        return int(self.tail.shape[1])
+
+
+class RunOut(NamedTuple):
+    """Result carry of one compiled search run."""
+
+    frontier: Frontier  # final: accepting/resume frontier (closed) or children
+    stop_code: jnp.ndarray  # 0 running, 1 accept, 2 empty, 3 capacity
+    accept_idx: jnp.ndarray
+    layers: jnp.ndarray
+    pruned_ever: jnp.ndarray
+    overflow_ever: jnp.ndarray
+    max_live: jnp.ndarray
+    max_state_set: jnp.ndarray
+    auto_closed: jnp.ndarray
+    expanded: jnp.ndarray
+
+
+STOP_RUNNING, STOP_ACCEPT, STOP_EMPTY, STOP_CAPACITY = 0, 1, 2, 3
+
+
+def build_tables(enc: EncodedHistory) -> SearchTables:
+    n = enc.num_ops
+    c, lc = enc.chain_ops.shape
+
+    is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
+    settable = set()
+    for j in range(n):
+        if enc.has_set_token[j]:
+            settable.add(int(enc.set_token[j]))
+    tok_never = np.array(
+        [
+            bool(enc.has_batch_token[j]) and int(enc.batch_token[j]) not in settable
+            for j in range(n)
+        ],
+        bool,
+    )
+    ac_match = is_indef & enc.has_match
+    ac_tok = is_indef & tok_never
+
+    accept_tab = np.ones((c, lc + 1), bool)
+    opens_tab = np.zeros((c, lc + 1), np.int32)
+    for ci in range(c):
+        ln = int(enc.chain_len[ci])
+        for k in range(ln):
+            opens_tab[ci, k + 1] = opens_tab[ci, k] + int(
+                is_indef[enc.chain_ops[ci, k]]
+            )
+        for k in range(ln - 1, -1, -1):
+            accept_tab[ci, k] = accept_tab[ci, k + 1] and bool(
+                is_indef[enc.chain_ops[ci, k]]
+            )
+    return SearchTables(
+        ops=DeviceOps.from_encoded(enc),
+        ac_match=jnp.asarray(ac_match),
+        ac_tok=jnp.asarray(ac_tok),
+        accept_tab=jnp.asarray(accept_tab),
+        opens_tab=jnp.asarray(opens_tab),
+    )
+
+
+def init_frontier(
+    enc: EncodedHistory, capacity: int, state_slots: int
+) -> Frontier:
+    c = enc.num_chains
+    states = [intern_state(enc, s) for s in enc.init_states]
+    states.sort()
+    if len(states) > state_slots:
+        raise ValueError(
+            f"{len(states)} initial states exceed {state_slots} state slots"
+        )
+    counts = np.zeros((capacity, c), np.int32)
+    counts[:] = enc.chain_start[None, :]
+    tail = np.zeros((capacity, state_slots), np.uint32)
+    hi = np.zeros((capacity, state_slots), np.uint32)
+    lo = np.zeros((capacity, state_slots), np.uint32)
+    tok = np.zeros((capacity, state_slots), np.int32)
+    svalid = np.zeros((capacity, state_slots), bool)
+    for i, (t, h, l, k) in enumerate(states):
+        tail[0, i], hi[0, i], lo[0, i], tok[0, i] = t, h, l, k
+        svalid[0, i] = True
+    valid = np.zeros(capacity, bool)
+    valid[0] = True
+    return Frontier(
+        counts=jnp.asarray(counts),
+        tail=jnp.asarray(tail),
+        hi=jnp.asarray(hi),
+        lo=jnp.asarray(lo),
+        tok=jnp.asarray(tok),
+        svalid=jnp.asarray(svalid),
+        valid=jnp.asarray(valid),
+    )
+
+
+def place_frontier(frontier: Frontier, mesh, axis: str = "fr") -> Frontier:
+    """Shard the frontier axis over a device mesh; tables stay replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(x):
+        spec = P(axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, frontier)
+
+
+# ---------------------------------------------------------------------------
+# Per-configuration pieces (to be vmapped over the frontier axis)
+# ---------------------------------------------------------------------------
+
+
+def _next_and_cands(tables: SearchTables, counts):
+    """Next-op index per chain and the candidate mask, for one config."""
+    ops = tables.ops
+    has_next = counts < ops.chain_len
+    idx = jnp.minimum(counts, jnp.maximum(ops.chain_len - 1, 0))
+    nxt = jnp.take_along_axis(ops.chain_ops, idx[:, None], axis=1)[:, 0]
+    nxt = jnp.where(has_next, nxt, 0)
+    nret = jnp.where(has_next, ops.ret[nxt], INF_TIME)
+    m = jnp.min(nret)
+    cand = has_next & (ops.call[nxt] < m)
+    return nxt, cand
+
+
+def _dead_mask(tables: SearchTables, nxt, cand, st_tail, st_tok, svalid):
+    """Candidates whose indefinite-append effect branch is dead forever."""
+    ops = tables.ops
+    ms = ops.match_seq[nxt]  # [C] u32
+    all_gt = ((~svalid)[None, :] | (st_tail[None, :] > ms[:, None])).all(axis=1)
+    bt = ops.batch_token[nxt]
+    none_match = ((~svalid)[None, :] | (st_tok[None, :] != bt[:, None])).all(axis=1)
+    dead = (tables.ac_match[nxt] & all_gt) | (tables.ac_tok[nxt] & none_match)
+    return cand & dead
+
+
+def _auto_close_one(tables: SearchTables, counts, st_tail, st_tok, svalid, cfg_valid):
+    def dead_now(c):
+        nxt, cand = _next_and_cands(tables, c)
+        return _dead_mask(tables, nxt, cand, st_tail, st_tok, svalid)
+
+    def cond(c):
+        return cfg_valid & dead_now(c).any()
+
+    def body(c):
+        return c + dead_now(c).astype(_I32)
+
+    closed = lax.while_loop(cond, body, counts)
+    return closed, (closed - counts).sum()
+
+
+def _expand_one(tables: SearchTables, counts, st_tail, st_hi, st_lo, st_tok, svalid, cfg_valid):
+    """All children of one configuration: one per candidate chain.
+
+    Returns per-chain arrays: child counts [C, C], canonical child state
+    sets [C, S]×4 (+ svalid), child validity [C], per-chain overflow [C].
+    """
+    c = counts.shape[0]
+    s = st_tail.shape[0]
+    nxt, cand = _next_and_cands(tables, counts)
+
+    def step_chain(o):
+        def per_state(t, h, l, k):
+            return step_kernel(tables.ops, o, DeviceState(t, h, l, k))
+
+        return jax.vmap(per_state)(st_tail, st_hi, st_lo, st_tok)
+
+    a, va, b, vb = jax.vmap(step_chain)(nxt)  # DeviceState [C,S], bool [C,S] ×2
+
+    # Two candidate successors per source state; dedup + canonicalize per chain.
+    t2 = jnp.concatenate([a.tail, b.tail], axis=1)  # [C, 2S]
+    h2 = jnp.concatenate([a.hash_hi, b.hash_hi], axis=1)
+    l2 = jnp.concatenate([a.hash_lo, b.hash_lo], axis=1)
+    k2 = jnp.concatenate([a.token, b.token], axis=1)
+    v2 = jnp.concatenate([va & svalid[None, :], vb & svalid[None, :]], axis=1)
+
+    def canon_row(t, h, l, k, v):
+        n2 = t.shape[0]
+        eqm = (
+            (t[:, None] == t[None, :])
+            & (h[:, None] == h[None, :])
+            & (l[:, None] == l[None, :])
+            & (k[:, None] == k[None, :])
+        )
+        lower = jnp.tril(jnp.ones((n2, n2), bool), -1)  # [i, j] = j < i
+        dup = (eqm & lower & v[None, :]).any(axis=1)
+        keep = v & ~dup
+        order = jnp.lexsort((k.astype(_U32), l, h, t, (~keep).astype(_I32)))
+        keep_s = keep[order][:s]
+        z = lambda x: jnp.where(keep_s, x[order][:s], 0)
+        return (
+            z(t),
+            z(h),
+            z(l),
+            jnp.where(keep_s, k[order][:s].astype(_I32), 0),
+            keep_s,
+            keep.sum() > s,
+        )
+
+    ct, ch, cl, ck, cv, over = jax.vmap(canon_row)(t2, h2, l2, k2, v2)
+    child_counts = counts[None, :] + jnp.eye(c, dtype=_I32)
+    child_valid = cfg_valid & cand & cv.any(axis=1)
+    overflow = (child_valid & over).any()
+    return child_counts, ct, ch, cl, ck, cv, child_valid, overflow, cand.sum()
+
+
+def _accept_one(tables: SearchTables, counts, cfg_valid):
+    c = counts.shape[0]
+    return cfg_valid & tables.accept_tab[jnp.arange(c), counts].all()
+
+
+# ---------------------------------------------------------------------------
+# The batched layer and the compiled search loop
+# ---------------------------------------------------------------------------
+
+
+def _mix_hash(cols, n, seed):
+    """FNV-1a-style column mix → one u32 lane hash per row."""
+    h = jnp.full(n, seed, _U32)
+    for x in cols:
+        h = (h ^ x.astype(_U32)) * _U32(0x01000193)
+        h = ((h << 13) | (h >> 19)) ^ (h >> 7)
+    # final avalanche
+    h = (h ^ (h >> 16)) * _U32(0x7FEB352D)
+    h = (h ^ (h >> 15)) * _U32(0x846CA68B)
+    return h ^ (h >> 16)
+
+
+def _expand_layer(tables: SearchTables, frontier: Frontier):
+    """Expand + dedup + compact one layer.  Returns (children, pruned,
+    overflow, n_unique, expanded, max_state_set)."""
+    f, c = frontier.counts.shape
+    s = frontier.state_slots
+
+    (ccounts, ct, ch, cl, ck, cv, cvalid, over, ncand) = jax.vmap(
+        partial(_expand_one, tables)
+    )(
+        frontier.counts,
+        frontier.tail,
+        frontier.hi,
+        frontier.lo,
+        frontier.tok,
+        frontier.svalid,
+        frontier.valid,
+    )
+    e = f * c
+    flat = lambda x: x.reshape((e,) + x.shape[2:])
+    ccounts, ct, ch, cl, ck, cv = map(flat, (ccounts, ct, ch, cl, ck, cv))
+    cvalid = cvalid.reshape(e)
+    overflow = over.any()
+    expanded = jnp.where(frontier.valid, ncand, 0).sum()
+
+    # Lazy-order rank: total indefinite appends linearized (fewest first).
+    # Invalid children can carry counts one past a finished chain; clamp.
+    idx = jnp.minimum(ccounts.T, tables.opens_tab.shape[1] - 1)
+    opens = jnp.take_along_axis(tables.opens_tab, idx, axis=1).sum(axis=0)
+
+    cols = (
+        [ccounts[:, i] for i in range(c)]
+        + [ct[:, i] for i in range(s)]
+        + [ch[:, i] for i in range(s)]
+        + [cl[:, i] for i in range(s)]
+        + [ck[:, i] for i in range(s)]
+        + [cv[:, i] for i in range(s)]
+    )
+    h1 = _mix_hash(cols, e, 0x811C9DC5)
+    h2 = _mix_hash(cols, e, 0x9747B28C)
+
+    order = jnp.lexsort((h2, h1, opens.astype(_I32), (~cvalid).astype(_I32)))
+    ccounts, ct, ch, cl, ck, cv = (
+        x[order] for x in (ccounts, ct, ch, cl, ck, cv)
+    )
+    cvalid, opens, h1, h2 = cvalid[order], opens[order], h1[order], h2[order]
+
+    eq_prev = jnp.ones(e, bool)
+    for x in (ccounts, ct, ch, cl, ck, cv):
+        eq_prev &= (x == jnp.roll(x, 1, axis=0)).all(axis=1)
+    eq_prev = eq_prev.at[0].set(False)
+    dup = cvalid & jnp.roll(cvalid, 1) & eq_prev
+    keep = cvalid & ~dup
+    n_unique = keep.sum()
+
+    order2 = jnp.lexsort(((~keep).astype(_I32),), axis=0)
+    take = lambda x: x[order2][:f]
+    children = Frontier(
+        counts=take(ccounts),
+        tail=take(ct),
+        hi=take(ch),
+        lo=take(cl),
+        tok=take(ck),
+        svalid=take(cv),
+        valid=keep[order2][:f],
+    )
+    pruned = n_unique > f
+    max_state_set = jnp.where(children.valid, children.svalid.sum(axis=1), 0).max()
+    return children, pruned, overflow, n_unique, expanded, max_state_set
+
+
+@partial(jax.jit, static_argnames=("allow_prune",))
+def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_prune: bool) -> RunOut:
+    """Run the frontier search to a verdict inside one compiled while_loop.
+
+    ``allow_prune=True``: capacity overruns prune to the lazy-best
+    configurations and the search continues (OK conclusive; dead ends
+    inconclusive).  ``allow_prune=False``: the loop exits with
+    STOP_CAPACITY and the pre-expansion frontier, so the driver can
+    escalate capacity and resume exactly (no information lost).
+    """
+
+    def body(carry: RunOut) -> RunOut:
+        cur = carry.frontier
+
+        closed_counts, ac_n = jax.vmap(partial(_auto_close_one, tables))(
+            cur.counts, cur.tail, cur.tok, cur.svalid, cur.valid
+        )
+        closed = cur._replace(counts=closed_counts)
+        acc_row = jax.vmap(partial(_accept_one, tables))(closed.counts, closed.valid)
+        accept_any = acc_row.any()
+
+        def do_expand(fr):
+            return _expand_layer(tables, fr)
+
+        def no_expand(fr):
+            zero = jnp.zeros((), _I32)
+            return fr, jnp.zeros((), bool), jnp.zeros((), bool), zero, zero, zero
+
+        children, pruned, overflow, n_unique, expanded, mss = lax.cond(
+            accept_any, no_expand, do_expand, closed
+        )
+        empty = ~accept_any & (n_unique == 0)
+        need_cap = (not allow_prune) & (pruned | overflow)
+        stop = jnp.where(
+            accept_any,
+            STOP_ACCEPT,
+            jnp.where(empty, STOP_EMPTY, jnp.where(need_cap, STOP_CAPACITY, STOP_RUNNING)),
+        ).astype(_I32)
+
+        resume = accept_any | need_cap
+        nxt = jax.tree.map(
+            lambda a, b: jnp.where(
+                resume.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
+            ),
+            closed,
+            children,
+        )
+        return RunOut(
+            frontier=nxt,
+            stop_code=stop,
+            accept_idx=jnp.argmax(acc_row).astype(_I32),
+            layers=carry.layers + 1,
+            pruned_ever=carry.pruned_ever | pruned,
+            overflow_ever=carry.overflow_ever | overflow,
+            max_live=jnp.maximum(carry.max_live, children.valid.sum()),
+            max_state_set=jnp.maximum(carry.max_state_set, mss),
+            auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
+            expanded=carry.expanded + expanded,
+        )
+
+    def cond(carry: RunOut):
+        return (carry.stop_code == STOP_RUNNING) & (carry.layers < max_layers)
+
+    zero = jnp.zeros((), _I32)
+    init = RunOut(
+        frontier=frontier,
+        stop_code=zero,
+        accept_idx=zero,
+        layers=zero,
+        pruned_ever=jnp.zeros((), bool),
+        overflow_ever=jnp.zeros((), bool),
+        max_live=jnp.ones((), _I32),
+        max_state_set=jnp.where(frontier.svalid[0], 1, 0).sum(),
+        auto_closed=zero,
+        expanded=zero,
+    )
+    return lax.while_loop(cond, body, init)
+
+
+# ---------------------------------------------------------------------------
+# Host driver
+# ---------------------------------------------------------------------------
+
+
+def _round_pow2(n: int, lo: int) -> int:
+    v = lo
+    while v < n:
+        v *= 2
+    return v
+
+
+def _final_states(enc: EncodedHistory, frontier: Frontier, idx: int) -> list[StreamState]:
+    tail = np.asarray(frontier.tail[idx])
+    hi = np.asarray(frontier.hi[idx])
+    lo = np.asarray(frontier.lo[idx])
+    tok = np.asarray(frontier.tok[idx])
+    sv = np.asarray(frontier.svalid[idx])
+    out = []
+    for i in range(sv.shape[0]):
+        if sv[i]:
+            out.append(
+                StreamState(
+                    tail=int(tail[i]),
+                    stream_hash=(int(hi[i]) << 32) | int(lo[i]),
+                    fencing_token=enc.token_of_id[int(tok[i])],
+                )
+            )
+    return sorted(out)
+
+
+def check_device(
+    history: History,
+    *,
+    max_frontier: int = 4096,
+    state_slots: int = 8,
+    beam: bool = True,
+    start_frontier: int = 64,
+    mesh=None,
+    collect_stats: bool = False,
+) -> CheckResult:
+    """Decide linearizability on device.  Verdict semantics match
+    :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
+    conclusive; a dead end after pruning/overflow is UNKNOWN.
+
+    Both modes start in a small frontier bucket and escalate (doubling,
+    resuming from the returned pre-expansion frontier) on capacity stops —
+    so cheap histories stay cheap.  At ``max_frontier`` a beam run switches
+    to prune-and-continue (lazy-order beam) inside the compiled loop, while
+    an exhaustive run concedes UNKNOWN.
+    """
+    enc = encode_history(history)
+    stats = FrontierStats()
+    if enc.total_remaining == 0:
+        res = CheckResult(
+            CheckOutcome.OK, linearization=[], final_states=sorted(enc.init_states)
+        )
+        if collect_stats:
+            res.stats = stats  # type: ignore[attr-defined]
+        return res
+    tables = build_tables(enc)
+    cap_layers = np.int32(enc.total_remaining + 2)
+
+    f = _round_pow2(min(start_frontier, max_frontier), 2)
+    f_cap = _round_pow2(max_frontier, 2)
+    s = _round_pow2(max(len(enc.init_states), state_slots), 2)
+    max_state_slots = 256
+    frontier = init_frontier(enc, f, s)
+    if mesh is not None:
+        frontier = place_frontier(frontier, mesh)
+
+    while True:
+        allow_prune = beam and f >= f_cap
+        out = jax.device_get(
+            run_search(tables, frontier, cap_layers, allow_prune=allow_prune)
+        )
+        stats.layers += int(out.layers)
+        stats.max_frontier = max(stats.max_frontier, int(out.max_live))
+        stats.max_state_set = max(stats.max_state_set, int(out.max_state_set))
+        stats.auto_closed += int(out.auto_closed)
+        stats.expanded += int(out.expanded)
+        if allow_prune:
+            stats.pruned = (
+                stats.pruned or bool(out.pruned_ever) or bool(out.overflow_ever)
+            )
+        code = int(out.stop_code)
+        if code == STOP_ACCEPT:
+            res = CheckResult(
+                CheckOutcome.OK,
+                linearization=None,
+                final_states=_final_states(enc, out.frontier, int(out.accept_idx)),
+            )
+            break
+        if code == STOP_EMPTY:
+            outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
+            res = CheckResult(outcome)
+            break
+        if code == STOP_CAPACITY:
+            # Capacity wall below the cap: escalate and resume from the
+            # returned pre-expansion frontier (no information was lost).
+            resume = Frontier(*(np.asarray(x) for x in out.frontier))
+            if bool(out.overflow_ever) and resume.state_slots < max_state_slots:
+                resume = _regrow(resume, resume.capacity, resume.state_slots * 2)
+            elif f < f_cap:
+                f = min(f * 2, f_cap)
+                resume = _regrow(resume, f, resume.state_slots)
+            else:
+                stats.pruned = True
+                res = CheckResult(CheckOutcome.UNKNOWN)
+                break
+            frontier = (
+                place_frontier(jax.tree.map(jnp.asarray, resume), mesh)
+                if mesh is not None
+                else jax.tree.map(jnp.asarray, resume)
+            )
+            continue
+        # Layer cap hit without a verdict: should be impossible (each layer
+        # linearizes exactly one op); treat as inconclusive.
+        res = CheckResult(CheckOutcome.UNKNOWN)
+        break
+
+    if collect_stats:
+        res.stats = stats  # type: ignore[attr-defined]
+    return res
+
+
+def _regrow(fr: Frontier, capacity: int, state_slots: int) -> Frontier:
+    """Re-pad a frontier into a (capacity, state_slots) bucket."""
+    f0, c = np.asarray(fr.counts).shape
+    s0 = fr.state_slots
+
+    def grow1(x):
+        out = np.zeros(capacity, np.asarray(x).dtype)
+        out[:f0] = np.asarray(x)
+        return out
+
+    def grow_c(x):
+        out = np.zeros((capacity, c), np.asarray(x).dtype)
+        out[:f0] = np.asarray(x)
+        return out
+
+    def grow_s(x):
+        out = np.zeros((capacity, state_slots), np.asarray(x).dtype)
+        out[:f0, :s0] = np.asarray(x)
+        return out
+
+    return Frontier(
+        counts=grow_c(fr.counts),
+        tail=grow_s(fr.tail),
+        hi=grow_s(fr.hi),
+        lo=grow_s(fr.lo),
+        tok=grow_s(fr.tok),
+        svalid=grow_s(fr.svalid),
+        valid=grow1(fr.valid),
+    )
+
+
+def check_device_auto(
+    history: History,
+    *,
+    beam_width: int = 4096,
+    exhaustive_cap: int = 16384,
+    state_slots: int = 8,
+    mesh=None,
+    collect_stats: bool = False,
+) -> CheckResult:
+    """Beam-first device check with exhaustive escalation, mirroring
+    :func:`..checker.frontier.check_frontier_auto`."""
+    res = check_device(
+        history,
+        max_frontier=beam_width,
+        state_slots=state_slots,
+        beam=True,
+        mesh=mesh,
+        collect_stats=collect_stats,
+    )
+    if res.outcome != CheckOutcome.UNKNOWN:
+        return res
+    return check_device(
+        history,
+        max_frontier=exhaustive_cap,
+        state_slots=state_slots,
+        beam=False,
+        mesh=mesh,
+        collect_stats=collect_stats,
+    )

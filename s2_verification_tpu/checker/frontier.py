@@ -35,13 +35,23 @@ precisely what makes adversarial histories CPU-intractable for Porcupine.
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass
 
-from ..models.stream import APPEND, StreamState, step_set
+from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
 from .entries import History, Op
 from .oracle import CheckOutcome, CheckResult
 
 __all__ = ["check_frontier", "check_frontier_auto", "FrontierStats"]
+
+
+def _cfg_digest(cfg) -> int:
+    """Deterministic (PYTHONHASHSEED-independent) beam tie-break digest."""
+    counts, states = cfg
+    parts = [",".join(map(str, counts))]
+    for s in sorted(states):
+        parts.append(f"{s.tail}:{s.stream_hash}:{s.fencing_token!r}")
+    return zlib.crc32("|".join(parts).encode())
 
 
 @dataclass
@@ -95,8 +105,6 @@ def check_frontier(
     stats = FrontierStats()
 
     if not ops:
-        from ..models.stream import INIT_STATE
-
         return CheckResult(CheckOutcome.OK, linearization=[], final_states=[INIT_STATE])
 
     settable_tokens = frozenset(
@@ -104,8 +112,6 @@ def check_frontier(
         for op in ops
         if op.inp.input_type == APPEND and op.inp.set_fencing_token is not None
     )
-
-    from ..models.stream import INIT_STATE
 
     init_counts = tuple(0 for _ in range(n_chains))
     frontier: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {
@@ -226,7 +232,7 @@ def check_frontier(
                 return res
             stats.pruned = True
             ranked = sorted(
-                children, key=lambda cfg: (opens_taken(cfg[0]), hash(cfg))
+                children, key=lambda cfg: (opens_taken(cfg[0]), _cfg_digest(cfg))
             )
             children = dict.fromkeys(ranked[:max_frontier])
         frontier = children

@@ -17,3 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's axon sitecustomize hook registers the TPU backend at
+# interpreter start and prepends it to jax_platforms, overriding the env var;
+# pin the platform list again through the config API (backends are created
+# lazily, so this wins as long as it runs before first device use).
+import jax
+
+jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

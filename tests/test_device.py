@@ -1,0 +1,184 @@
+"""Differential tests: device frontier search vs the host engines.
+
+Runs on the virtual 8-device CPU mesh (conftest.py); the same code path runs
+unchanged on real TPU chips.
+"""
+
+import random
+
+import jax
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.checker.device import (
+    check_device,
+    check_device_auto,
+    place_frontier,
+)
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from test_oracle_bruteforce import random_history
+
+
+def test_device_matches_dfs_on_random_histories():
+    rng = random.Random(0xDEC0)
+    for trial in range(60):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        want = check(hist)
+        got = check_device(hist, max_frontier=256, start_frontier=16, beam=False)
+        assert got.outcome == want.outcome, f"trial {trial}"
+        if want.ok:
+            assert got.final_states, f"trial {trial}"
+            # Engines may surface different accepting linearizations; only a
+            # history with no ambiguous appends has a unique final state.
+            if not any(op.is_indefinite_append for op in hist.ops):
+                assert sorted(got.final_states) == sorted(want.final_states), (
+                    f"trial {trial}"
+                )
+
+
+def test_device_beam_matches_on_random_histories():
+    rng = random.Random(0xBEA3)
+    for trial in range(40):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        want = check(hist).outcome
+        got = check_device(hist, max_frontier=256, start_frontier=64, beam=True).outcome
+        # Beam OK/ILLEGAL-without-pruning are conclusive; UNKNOWN allowed.
+        if got != CheckOutcome.UNKNOWN:
+            assert got == want, f"trial {trial}"
+
+
+@pytest.mark.parametrize("workflow", ["regular", "match-seq-num", "fencing"])
+def test_device_on_collected_histories(workflow):
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=25,
+            workflow=workflow,
+            seed=7,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.3, max_latency=0.001),
+        )
+    )
+    hist = prepare(events)
+    res = check_device_auto(hist, beam_width=512, collect_stats=True)
+    assert res.outcome == CheckOutcome.OK
+    host = check_frontier(hist)
+    assert host.outcome == CheckOutcome.OK
+
+
+def test_device_rejects_corrupted_history():
+    from s2_verification_tpu.utils.events import LabeledEvent, ReadSuccess
+
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=15,
+            workflow="regular",
+            seed=3,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.2, max_latency=0.001),
+        )
+    )
+    tampered = []
+    done = False
+    for e in events:
+        if not done and isinstance(e.event, ReadSuccess) and e.event.tail > 0:
+            e = LabeledEvent(
+                ReadSuccess(tail=e.event.tail, stream_hash=e.event.stream_hash ^ 1),
+                e.client_id,
+                e.op_id,
+            )
+            done = True
+        tampered.append(e)
+    assert done
+    hist = prepare(tampered)
+    assert check_device(hist, beam=False).outcome == CheckOutcome.ILLEGAL
+
+
+def test_device_auto_close_keeps_frontier_narrow():
+    h = H()
+    tail, acc = 0, 0
+    for i in range(3):
+        rh = 200 + i
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    for i in range(10):
+        h.call_append(100 + i, [i + 1], match=i % 3)  # dead open guards
+    for i in range(20):
+        rh = 50 + i
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    h.read_ok(2, tail=tail, stream_hash=acc)
+    hist = prepare(h.events)
+    res = check_device(hist, start_frontier=16, beam=False, collect_stats=True)
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.auto_closed >= 10
+    assert res.stats.max_frontier <= 8
+
+
+def test_device_state_slot_escalation():
+    # k live unguarded opens before a pinning read: state sets reach 2^k
+    # members, overflowing the starting slot bucket; the driver must regrow
+    # and still conclude OK.
+    h = H()
+    k = 4
+    opens = []
+    for i in range(k):
+        opens.append(h.call_append(10 + i, [i + 1]))
+    tail, acc = 0, 0
+    for i in range(3):
+        rh = 90 + i
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    h.read_ok(2, tail=tail, stream_hash=acc)  # pins: no open took effect
+    hist = prepare(h.events)
+    res = check_device(hist, state_slots=2, start_frontier=16, beam=False, collect_stats=True)
+    want = check(hist)
+    assert res.outcome == want.outcome == CheckOutcome.OK
+
+
+def test_device_frontier_escalation_exhaustive():
+    # Live ambiguity wider than the starting bucket: exhaustive mode must
+    # escalate the frontier and still match the oracle.
+    h = H()
+    for i in range(6):
+        h.call_append(10 + i, [i + 1])
+    h.append_ok(1, [99], tail=1)
+    hist = prepare(h.events)
+    res = check_device(hist, start_frontier=2, max_frontier=256, state_slots=2, beam=False)
+    assert res.outcome == check(hist).outcome
+
+
+def test_device_sharded_over_mesh():
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provide the virtual 8-device mesh"
+    mesh = Mesh(devices[:8], ("fr",))
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=20,
+            workflow="match-seq-num",
+            seed=11,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.3, max_latency=0.001),
+        )
+    )
+    hist = prepare(events)
+    res = check_device(hist, start_frontier=64, mesh=mesh, beam=False)
+    assert res.outcome == CheckOutcome.OK
+
+
+def test_device_empty_history():
+    hist = prepare([])
+    assert check_device(hist).outcome == CheckOutcome.OK
