@@ -456,17 +456,27 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             closed,
             children,
         )
+        # A capacity stop abandons this layer's expansion (the driver resumes
+        # from the pre-expansion frontier and replays it), so only committed
+        # layers contribute to the counters — resumed stats stay exact.
+        committed = ~need_cap
+        zero = jnp.zeros((), _I32)
         return RunOut(
             frontier=nxt,
             stop_code=stop,
             accept_idx=jnp.argmax(acc_row).astype(_I32),
-            layers=carry.layers + 1,
+            layers=carry.layers + committed.astype(_I32),
             pruned_ever=carry.pruned_ever | pruned,
             overflow_ever=carry.overflow_ever | overflow,
-            max_live=jnp.maximum(carry.max_live, children.valid.sum()),
-            max_state_set=jnp.maximum(carry.max_state_set, mss),
-            auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
-            expanded=carry.expanded + expanded,
+            max_live=jnp.maximum(
+                carry.max_live, jnp.where(committed, children.valid.sum(), 0)
+            ),
+            max_state_set=jnp.maximum(
+                carry.max_state_set, jnp.where(committed, mss, 0)
+            ),
+            auto_closed=carry.auto_closed
+            + jnp.where(committed, jnp.where(cur.valid, ac_n, 0).sum(), zero),
+            expanded=carry.expanded + jnp.where(committed, expanded, zero),
         )
 
     def cond(carry: RunOut):
@@ -480,8 +490,10 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         layers=zero,
         pruned_ever=jnp.zeros((), bool),
         overflow_ever=jnp.zeros((), bool),
-        max_live=jnp.ones((), _I32),
-        max_state_set=jnp.where(frontier.svalid[0], 1, 0).sum(),
+        max_live=frontier.valid.sum().astype(_I32),
+        max_state_set=jnp.where(frontier.valid, frontier.svalid.sum(axis=1), 0)
+        .max()
+        .astype(_I32),
         auto_closed=zero,
         expanded=zero,
     )
@@ -496,6 +508,14 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
 def _round_pow2(n: int, lo: int) -> int:
     v = lo
     while v < n:
+        v *= 2
+    return v
+
+
+def _floor_pow2(n: int, lo: int) -> int:
+    """Largest power of two ≤ n (but ≥ lo) — honors a caller's capacity cap."""
+    v = lo
+    while v * 2 <= n:
         v *= 2
     return v
 
@@ -538,12 +558,20 @@ def check_device(
     so cheap histories stay cheap.  At ``max_frontier`` a beam run switches
     to prune-and-continue (lazy-order beam) inside the compiled loop, while
     an exhaustive run concedes UNKNOWN.
+
+    Caveat: in a pruning beam run, a per-configuration state-set overflow
+    drops candidate states (OK stays sound — surviving states are genuinely
+    reachable — but ``final_states`` may then be a subset of the host
+    engine's).  ``stats.pruned`` records that this happened
+    (``collect_stats=True``).
     """
     enc = encode_history(history)
     stats = FrontierStats()
     if enc.total_remaining == 0:
         res = CheckResult(
-            CheckOutcome.OK, linearization=[], final_states=sorted(enc.init_states)
+            CheckOutcome.OK,
+            linearization=list(enc.forced_prefix),
+            final_states=sorted(enc.init_states),
         )
         if collect_stats:
             res.stats = stats  # type: ignore[attr-defined]
@@ -551,8 +579,8 @@ def check_device(
     tables = build_tables(enc)
     cap_layers = np.int32(enc.total_remaining + 2)
 
-    f = _round_pow2(min(start_frontier, max_frontier), 2)
-    f_cap = _round_pow2(max_frontier, 2)
+    f_cap = _floor_pow2(max_frontier, 2)
+    f = _round_pow2(min(start_frontier, f_cap), 2)
     s = _round_pow2(max(len(enc.init_states), state_slots), 2)
     max_state_slots = 256
     frontier = init_frontier(enc, f, s)
@@ -589,7 +617,13 @@ def check_device(
             # Capacity wall below the cap: escalate and resume from the
             # returned pre-expansion frontier (no information was lost).
             resume = Frontier(*(np.asarray(x) for x in out.frontier))
-            if bool(out.overflow_ever) and resume.state_slots < max_state_slots:
+            if bool(out.overflow_ever) and resume.state_slots >= max_state_slots:
+                # Widening the frontier cannot fix a per-configuration
+                # state-set overflow: concede rather than escalate futilely.
+                stats.pruned = True
+                res = CheckResult(CheckOutcome.UNKNOWN)
+                break
+            if bool(out.overflow_ever):
                 resume = _regrow(resume, resume.capacity, resume.state_slots * 2)
             elif f < f_cap:
                 f = min(f * 2, f_cap)
