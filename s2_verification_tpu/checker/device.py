@@ -460,7 +460,6 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         # from the pre-expansion frontier and replays it), so only committed
         # layers contribute to the counters — resumed stats stay exact.
         committed = ~need_cap
-        zero = jnp.zeros((), _I32)
         return RunOut(
             frontier=nxt,
             stop_code=stop,
@@ -474,9 +473,12 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             max_state_set=jnp.maximum(
                 carry.max_state_set, jnp.where(committed, mss, 0)
             ),
-            auto_closed=carry.auto_closed
-            + jnp.where(committed, jnp.where(cur.valid, ac_n, 0).sum(), zero),
-            expanded=carry.expanded + jnp.where(committed, expanded, zero),
+            # auto_closed stays ungated: the resume frontier handed back on a
+            # capacity stop is post-auto-close, so that work IS committed and
+            # will not be replayed.
+            auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
+            expanded=carry.expanded
+            + jnp.where(committed, expanded, jnp.zeros((), _I32)),
         )
 
     def cond(carry: RunOut):
@@ -619,11 +621,17 @@ def check_device(
             resume = Frontier(*(np.asarray(x) for x in out.frontier))
             if bool(out.overflow_ever) and resume.state_slots >= max_state_slots:
                 # Widening the frontier cannot fix a per-configuration
-                # state-set overflow: concede rather than escalate futilely.
-                stats.pruned = True
-                res = CheckResult(CheckOutcome.UNKNOWN)
-                break
-            if bool(out.overflow_ever):
+                # state-set overflow.  A beam run jumps straight to the
+                # pruning regime (state drops keep OK sound — see caveat
+                # above); an exhaustive run must concede.
+                if beam and f < f_cap:
+                    f = f_cap
+                    resume = _regrow(resume, f, resume.state_slots)
+                else:
+                    stats.pruned = True
+                    res = CheckResult(CheckOutcome.UNKNOWN)
+                    break
+            elif bool(out.overflow_ever):
                 resume = _regrow(resume, resume.capacity, resume.state_slots * 2)
             elif f < f_cap:
                 f = min(f * 2, f_cap)

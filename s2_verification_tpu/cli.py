@@ -1,0 +1,216 @@
+"""Command-line interface: the reference's two binaries as one CLI.
+
+``check``   — the ``s2-porcupine`` equivalent (golang/s2-porcupine/main.go:566-640):
+              reads a JSONL history (``-file``, '-' = stdin), decides
+              linearizability, always writes an HTML visualization under
+              ``./porcupine-outputs/``, exits 0 on OK / 1 on not-linearizable.
+``collect`` — the ``collect-history`` equivalent
+              (rust/s2-verification/src/bin/collect-history.rs:26-201), run
+              against the in-process fault-injecting fake S2 (this
+              environment has no network): writes
+              ``./data/records.<epoch>.jsonl`` and prints the path.
+
+Backends for ``check``:
+
+- ``oracle``   — Wing–Gong DFS with memoization (CPU; the default oracle).
+- ``frontier`` — host BFS frontier engine (CPU; the device twin's reference).
+- ``device``   — the compiled TPU frontier search.
+- ``auto``     — oracle with a time budget, escalating to the device search
+                 when the budget expires (CPU stays the default path; the
+                 accelerator handles what the CPU cannot).
+
+Exit codes: 0 linearizable, 1 not linearizable, 2 inconclusive, 64 usage /
+decode errors (the reference distinguishes only 0/1; UNKNOWN has no
+reference analog because Porcupine's timeout-0 runs are unbounded).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import tempfile
+import time
+
+from . import version as _version
+from .checker.entries import History, prepare
+from .checker.oracle import CheckOutcome, CheckResult, check
+from .collector.collect import CollectConfig, collect_to_file
+from .collector.fake_s2 import FaultPlan
+from .utils import events as ev
+
+__all__ = ["main"]
+
+log = logging.getLogger("s2_verification_tpu")
+
+
+def _read_events(path: str) -> list[ev.LabeledEvent]:
+    if path == "-":
+        return list(ev.iter_history(sys.stdin))
+    return ev.read_history(path)
+
+
+def _run_backend(
+    backend: str, hist: History, time_budget_s: float | None
+) -> CheckResult:
+    if backend == "oracle":
+        return check(hist, time_budget_s=time_budget_s)
+    if backend == "frontier":
+        from .checker.frontier import check_frontier_auto
+
+        return check_frontier_auto(hist)
+    if backend == "device":
+        from .checker.device import check_device_auto
+
+        return check_device_auto(hist)
+    if backend == "auto":
+        budget = time_budget_s if time_budget_s is not None else 10.0
+        res = check(hist, time_budget_s=budget)
+        if res.outcome != CheckOutcome.UNKNOWN:
+            return res
+        log.info("oracle hit its %.1fs budget; escalating to the device search", budget)
+        from .checker.device import check_device_auto
+
+        return check_device_auto(hist)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def _cmd_check(args: argparse.Namespace) -> int:
+    try:
+        events = _read_events(args.file)
+    except (OSError, ValueError) as e:
+        log.error("failed to read history: %s", e)
+        return 64
+    try:
+        checked = prepare(events, elide_trivial=True)
+    except ValueError as e:
+        log.error("malformed history: %s", e)
+        return 64
+
+    t0 = time.monotonic()
+    res = _run_backend(args.backend, checked, args.time_budget)
+    dt = time.monotonic() - t0
+
+    if not args.no_viz:
+        # Always emit the visualization, success or not, like the reference
+        # (main.go:608-631): porcupine-outputs/<base>-<unique>.html.
+        from .viz import render_html
+
+        full = prepare(events, elide_trivial=False)
+        os.makedirs(args.out_dir, exist_ok=True)
+        base = "stdin" if args.file == "-" else os.path.basename(args.file)
+        fd, path = tempfile.mkstemp(
+            prefix=f"{base}-", suffix=".html", dir=args.out_dir
+        )
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(
+                render_html(
+                    full,
+                    res,
+                    title=f"s2 linearizability check — {base}",
+                    checked=checked,
+                )
+            )
+        log.info("wrote visualization to %s", path)
+
+    if res.outcome == CheckOutcome.OK:
+        log.info(
+            "history is linearizable (%s backend, %.3fs, %d ops)",
+            args.backend,
+            dt,
+            len(checked.ops),
+        )
+        return 0
+    if res.outcome == CheckOutcome.ILLEGAL:
+        log.error(
+            "history is NOT linearizable (%s backend, %.3fs)", args.backend, dt
+        )
+        return 1
+    log.error("check inconclusive (%s backend, %.3fs)", args.backend, dt)
+    return 2
+
+
+def _cmd_collect(args: argparse.Namespace) -> int:
+    faults = FaultPlan.chaos(args.chaos) if args.chaos > 0 else FaultPlan()
+    cfg = CollectConfig(
+        num_concurrent_clients=args.num_concurrent_clients,
+        num_ops_per_client=args.num_ops_per_client,
+        workflow=args.workflow,
+        seed=args.seed,
+        faults=faults,
+    )
+    path = collect_to_file(cfg, out_dir=args.out_dir)
+    # The reference prints the history path as its last act
+    # (collect-history.rs:195-200).
+    print(path)
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="s2-verification-tpu",
+        description="TPU-native S2 linearizability verification framework",
+    )
+    p.add_argument(
+        "-version", "--version", action="version", version=_version.__version__
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    c = sub.add_parser("check", help="check a JSONL history for linearizability")
+    c.add_argument(
+        "-file", "--file", required=True, help="history JSONL path, '-' for stdin"
+    )
+    c.add_argument(
+        "-backend",
+        "--backend",
+        default="auto",
+        choices=["oracle", "frontier", "device", "auto"],
+    )
+    c.add_argument(
+        "-time-budget",
+        "--time-budget",
+        type=float,
+        default=None,
+        help="oracle time budget in seconds (auto backend default: 10)",
+    )
+    c.add_argument("-out-dir", "--out-dir", default="./porcupine-outputs")
+    c.add_argument(
+        "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
+    )
+    c.set_defaults(fn=_cmd_check)
+
+    g = sub.add_parser("collect", help="collect a history against the fake S2")
+    g.add_argument("basin", nargs="?", default="local")
+    g.add_argument("stream", nargs="?", default="stream")
+    g.add_argument("--num-concurrent-clients", type=int, default=5)
+    g.add_argument("--num-ops-per-client", type=int, default=100)
+    g.add_argument(
+        "--workflow",
+        default="regular",
+        choices=["regular", "match-seq-num", "fencing"],
+    )
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument(
+        "--chaos",
+        type=float,
+        default=0.2,
+        help="fault-injection intensity for the fake S2 (0 disables)",
+    )
+    g.add_argument("--out-dir", default="./data")
+    g.set_defaults(fn=_cmd_collect)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        stream=sys.stderr,
+        level=os.environ.get("S2VTPU_LOG", "INFO").upper(),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

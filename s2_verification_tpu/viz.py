@@ -1,0 +1,187 @@
+"""HTML visualization of a history and its (partial) linearization.
+
+The equivalent of ``porcupine.Visualize`` as used by the reference checker
+(golang/s2-porcupine/main.go:608-631): a self-contained interactive HTML
+timeline, one horizontal lane per client, one bar per operation spanning its
+call→return window in real time, annotated with the linearization order when
+the check succeeded (or the deepest linearized prefix found when it failed).
+
+No external assets: styles and the tooltip script are inlined so the file
+renders offline, matching the reference's single-artifact behavior.
+"""
+
+from __future__ import annotations
+
+import html
+
+from .checker.entries import History, Op
+from .checker.oracle import CheckOutcome, CheckResult
+from .models.stream import describe_operation, describe_state
+
+__all__ = ["render_html", "write_visualization"]
+
+
+_CSS = """
+body { font: 13px/1.45 -apple-system, 'Segoe UI', Roboto, sans-serif;
+       margin: 24px; color: #1a1d21; background: #fff; }
+h1 { font-size: 17px; margin: 0 0 2px; }
+.meta { color: #5f6672; margin-bottom: 14px; }
+.verdict { display: inline-block; padding: 2px 10px; border-radius: 10px;
+           font-weight: 600; }
+.verdict.ok { background: #e3f4e6; color: #176936; }
+.verdict.illegal { background: #fdebec; color: #a12622; }
+.verdict.unknown { background: #fff3dc; color: #8a6100; }
+.lane { display: flex; align-items: center; margin: 3px 0; }
+.lane-label { width: 84px; flex: none; text-align: right; padding-right: 10px;
+              color: #5f6672; font-variant-numeric: tabular-nums; }
+.lane-track { position: relative; flex: 1; height: 26px;
+              background: #f4f5f7; border-radius: 4px; }
+.op { position: absolute; top: 3px; height: 20px; border-radius: 3px;
+      min-width: 7px; box-sizing: border-box; cursor: default;
+      border: 1px solid rgba(0,0,0,.25); }
+.op.success { background: #9fd7ab; }
+.op.definite { background: #f3a6a3; }
+.op.indef { background: #ffd488; border-style: dashed; }
+.op.pending { background: #ffd488; border-style: dashed; opacity: .75; }
+.op .ord { position: absolute; top: -1px; left: 2px; font-size: 10px;
+           font-weight: 700; color: #19306b; }
+.op.linearized { outline: 2px solid #4164c9; }
+.legend { margin: 14px 0 0; color: #5f6672; }
+.legend span.chip { display: inline-block; width: 12px; height: 12px;
+                    border-radius: 3px; margin: 0 4px 0 12px;
+                    vertical-align: -2px; border: 1px solid rgba(0,0,0,.25); }
+#tip { position: fixed; display: none; max-width: 560px; z-index: 10;
+       background: #1a1d21; color: #f4f5f7; padding: 7px 10px;
+       border-radius: 5px; font-size: 12px; white-space: pre-wrap;
+       pointer-events: none; }
+.final { margin-top: 14px; }
+code { background: #f4f5f7; padding: 1px 4px; border-radius: 3px; }
+"""
+
+_JS = """
+const tip = document.getElementById('tip');
+document.querySelectorAll('.op').forEach(el => {
+  el.addEventListener('mousemove', e => {
+    tip.textContent = el.dataset.tip;
+    tip.style.display = 'block';
+    tip.style.left = Math.min(e.clientX + 14, innerWidth - 580) + 'px';
+    tip.style.top = (e.clientY + 14) + 'px';
+  });
+  el.addEventListener('mouseleave', () => tip.style.display = 'none');
+});
+"""
+
+
+def _op_class(op: Op) -> str:
+    if op.pending:
+        return "pending"
+    if not op.out.failure:
+        return "success"
+    if op.out.definite_failure:
+        return "definite"
+    return "indef"
+
+
+def render_html(
+    history: History,
+    result: CheckResult,
+    *,
+    title: str = "s2 linearizability check",
+    checked: History | None = None,
+) -> str:
+    """Render the timeline.  ``history`` is the full prepared history shown
+    in the lanes; ``checked`` is the (possibly trivial-op-elided) history the
+    result's op indices refer to — linearization annotations are joined back
+    onto the full history by wire ``op_id``."""
+    checked = checked if checked is not None else history
+    order_by_opid: dict[int, int] = {}
+    if result.linearization is not None:
+        for pos, idx in enumerate(result.linearization):
+            order_by_opid[checked.ops[idx].op_id] = pos + 1
+    deepest_opids = {checked.ops[i].op_id for i in (result.deepest or [])}
+
+    n_events = max((op.ret for op in history.ops if not op.pending), default=1)
+    n_events = max(n_events, max((op.call for op in history.ops), default=0) + 1)
+    span = float(n_events + 1)
+
+    lanes: list[str] = []
+    for chain_id, members in enumerate(history.chains):
+        if not members:
+            continue
+        client = history.ops[members[0]].client_id
+        bars = []
+        for op_index in sorted(members, key=lambda i: history.ops[i].call):
+            op = history.ops[op_index]
+            left = 100.0 * op.call / span
+            right_ev = n_events + 1 if op.pending else op.ret + 1
+            width = max(100.0 * (right_ev - op.call) / span, 0.45)
+            ordinal = order_by_opid.get(op.op_id)
+            classes = ["op", _op_class(op)]
+            if ordinal is not None or op.op_id in deepest_opids:
+                classes.append("linearized")
+            tip = (
+                f"op {op.op_id} (client {op.client_id})\n"
+                f"{describe_operation(op.inp, op.out)}\n"
+                f"window: call@{op.call} → "
+                f"{'pending' if op.pending else f'ret@{op.ret}'}"
+            )
+            if ordinal is not None:
+                tip += f"\nlinearized at position {ordinal}"
+            ord_html = f'<span class="ord">{ordinal}</span>' if ordinal else ""
+            tip_attr = html.escape(tip, quote=True).replace("\n", "&#10;")
+            bars.append(
+                f'<div class="{" ".join(classes)}" '
+                f'style="left:{left:.3f}%;width:{width:.3f}%" '
+                f'data-tip="{tip_attr}">{ord_html}</div>'
+            )
+        lanes.append(
+            f'<div class="lane"><div class="lane-label">client {client}</div>'
+            f'<div class="lane-track">{"".join(bars)}</div></div>'
+        )
+
+    v = result.outcome.value
+    verdict = f'<span class="verdict {v}">{v.upper()}</span>'
+    pieces = [
+        f"<h1>{html.escape(title)}</h1>",
+        f'<div class="meta">{verdict} &nbsp; '
+        f"{len(history.ops)} ops, {sum(1 for o in history.ops if o.pending)} pending, "
+        f"{len([m for m in history.chains if m])} clients</div>",
+        *lanes,
+        '<div class="legend">'
+        '<span class="chip" style="background:#9fd7ab"></span>success'
+        '<span class="chip" style="background:#f3a6a3"></span>definite failure'
+        '<span class="chip" style="background:#ffd488;border-style:dashed"></span>'
+        "indefinite/pending"
+        '<span class="chip" style="background:#fff;outline:2px solid #4164c9">'
+        "</span>linearized</div>",
+    ]
+    if result.ok and result.final_states:
+        states = ", ".join(
+            f"<code>{html.escape(describe_state(s))}</code>"
+            for s in result.final_states
+        )
+        pieces.append(f'<div class="final">final states: {states}</div>')
+    elif result.outcome == CheckOutcome.ILLEGAL and result.deepest:
+        pieces.append(
+            f'<div class="final">deepest linearized prefix: '
+            f"{len(result.deepest)} / "
+            f"{sum(1 for o in checked.ops)} ops (outlined)</div>"
+        )
+    body = "\n".join(pieces)
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{html.escape(title)}</title><style>{_CSS}</style></head>"
+        f"<body>{body}<div id='tip'></div><script>{_JS}</script></body></html>"
+    )
+
+
+def write_visualization(
+    path: str,
+    history: History,
+    result: CheckResult,
+    *,
+    title: str = "s2 linearizability check",
+    checked: History | None = None,
+) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(render_html(history, result, title=title, checked=checked))

@@ -1,0 +1,143 @@
+"""CLI + visualization tests: the reference binaries' contracts.
+
+Reference parity: s2-porcupine exits 0 on linearizable, 1 otherwise, and
+always writes an HTML artifact (golang/s2-porcupine/main.go:605-638);
+collect-history writes ./data/records.<epoch>.jsonl and prints the path
+(rust/s2-verification/src/bin/collect-history.rs:120-200).
+"""
+
+import json
+import os
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import check
+from s2_verification_tpu.cli import main
+from s2_verification_tpu.utils import events as ev
+from s2_verification_tpu.viz import render_html
+
+
+@pytest.fixture(scope="module")
+def history_path(tmp_path_factory):
+    out = tmp_path_factory.mktemp("data")
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(
+            [
+                "collect",
+                "--num-concurrent-clients",
+                "3",
+                "--num-ops-per-client",
+                "12",
+                "--workflow",
+                "match-seq-num",
+                "--seed",
+                "5",
+                "--out-dir",
+                str(out),
+            ]
+        )
+    assert rc == 0
+    path = buf.getvalue().strip()
+    assert os.path.exists(path)
+    return path
+
+
+def test_collect_roundtrips(history_path):
+    events = ev.read_history(history_path)
+    assert events
+    hist = prepare(events)
+    assert check(hist).ok
+
+
+def test_check_ok_exit0_and_artifact(history_path, tmp_path):
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "oracle",
+            "-out-dir",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+    arts = list(tmp_path.iterdir())
+    assert len(arts) == 1 and arts[0].suffix == ".html"
+    text = arts[0].read_text()
+    assert "OK" in text and "lane" in text
+
+
+def test_check_frontier_backend(history_path, tmp_path):
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "frontier",
+            "-out-dir",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
+
+
+def test_check_corrupt_exit1(history_path, tmp_path):
+    lines = open(history_path).read().splitlines()
+    out = []
+    flipped = False
+    for line in lines:
+        o = json.loads(line)
+        fin = o["event"].get("Finish") if isinstance(o["event"], dict) else None
+        if (
+            not flipped
+            and isinstance(fin, dict)
+            and isinstance(fin.get("ReadSuccess"), dict)
+            and fin["ReadSuccess"].get("tail", 0) > 0
+        ):
+            fin["ReadSuccess"]["stream_hash"] ^= 1
+            flipped = True
+        out.append(json.dumps(o))
+    assert flipped, "history has no successful non-empty read to corrupt"
+    bad = tmp_path / "corrupt.jsonl"
+    bad.write_text("\n".join(out) + "\n")
+    rc = main(
+        ["check", "-file", str(bad), "-backend", "oracle", "-out-dir", str(tmp_path / "v")]
+    )
+    assert rc == 1
+    # The artifact is written even for failing histories (main.go:608-631).
+    assert any(p.suffix == ".html" for p in (tmp_path / "v").iterdir())
+
+
+def test_check_malformed_exit64(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("garbage {\n")
+    assert main(["check", "-file", str(bad), "-no-viz"]) == 64
+
+
+def test_check_missing_file_exit64(tmp_path):
+    assert main(["check", "-file", str(tmp_path / "nope.jsonl"), "-no-viz"]) == 64
+
+
+def test_viz_annotates_linearization(history_path):
+    events = ev.read_history(history_path)
+    checked = prepare(events)
+    full = prepare(events, elide_trivial=False)
+    res = check(checked)
+    html_text = render_html(full, res, checked=checked)
+    assert html_text.count('class="lane"') == len([c for c in full.chains if c])
+    assert html_text.count("op ") >= len(full.ops)
+    # every checked op got a linearization ordinal
+    assert html_text.count('<span class="ord">') == len(checked.ops)
+
+
+def test_version_flag(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["-version"])
+    assert e.value.code == 0
