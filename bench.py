@@ -1,0 +1,108 @@
+"""Benchmark: north-star config from BASELINE.json on the local chip.
+
+Collects a 5-client x 2000-op `match-seq-num` history with the seeded fake
+S2, verifies it with the compiled device frontier search, and prints ONE
+JSON line:
+
+    {"metric": "ops_verified_per_sec_chip", "value": N, "unit": "ops/s",
+     "vs_baseline": R}
+
+``value`` is checked-ops / steady-state device wall-clock (first run warms
+the XLA compile cache; the second run is timed — standard JAX practice).
+``vs_baseline`` is the north-star target time (BASELINE.json: verify this
+history in <10 s) divided by the measured device time — ≥1.0 means the
+target is met.  The CPU Wing–Gong oracle's time on the same history is
+reported on stderr for reference (on collector-produced OK histories the
+oracle resolves ambiguity quickly via reads; the device engine's edge is
+worst-case adversarial histories and scale).
+
+Env knobs (all optional): S2VTPU_BENCH_CLIENTS, S2VTPU_BENCH_OPS,
+S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+
+
+def main() -> int:
+    clients = int(os.environ.get("S2VTPU_BENCH_CLIENTS", "5"))
+    ops = int(os.environ.get("S2VTPU_BENCH_OPS", "2000"))
+    seed = int(os.environ.get("S2VTPU_BENCH_SEED", "20260729"))
+    oracle_budget = float(os.environ.get("S2VTPU_BENCH_ORACLE_BUDGET_S", "60"))
+
+    # Fault rates are tuned to the reference's client-id budget
+    # (MAX_CLIENT_IDS=20, history.rs:32): every indefinite append burns one
+    # rotation, so the rate must leave the full op count collectable while
+    # still parking ~a dozen open ambiguous appends — the factor that makes
+    # the history adversarial for a Wing–Gong CPU search.
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=clients,
+            num_ops_per_client=ops,
+            workflow="match-seq-num",
+            seed=seed,
+            faults=FaultPlan(
+                p_append_definite=0.05,
+                p_append_indefinite=12.0 / max(clients * ops, 1),
+                p_read_fail=0.02,
+                p_check_tail_fail=0.02,
+            ),
+        )
+    )
+    hist = prepare(events)
+    n_ops = len(hist.ops)
+    print(f"# history: {clients}x{ops} match-seq-num, {n_ops} checked ops", file=sys.stderr)
+
+    from s2_verification_tpu.checker.device import check_device_auto
+
+    # Warm-up run compiles every (capacity, slots) bucket this history needs.
+    t0 = time.monotonic()
+    res = check_device_auto(hist)
+    warm_s = time.monotonic() - t0
+    if res.outcome != CheckOutcome.OK:
+        print(f"# device outcome {res.outcome} (expected OK)", file=sys.stderr)
+        print(json.dumps({"metric": "ops_verified_per_sec_chip", "value": 0.0, "unit": "ops/s", "vs_baseline": 0.0}))
+        return 1
+    t0 = time.monotonic()
+    res2 = check_device_auto(hist)
+    dev_s = time.monotonic() - t0
+    assert res2.outcome == CheckOutcome.OK
+    print(f"# device: warm {warm_s:.2f}s, steady {dev_s:.2f}s", file=sys.stderr)
+
+    t0 = time.monotonic()
+    ores = check(hist, time_budget_s=oracle_budget)
+    oracle_s = time.monotonic() - t0
+    if ores.outcome == CheckOutcome.OK:
+        note = f"finished in {oracle_s:.2f}s"
+    else:
+        note = f"timed out at {oracle_budget:.0f}s"
+    print(f"# oracle (CPU Wing–Gong): {note}", file=sys.stderr)
+
+    target_s = 10.0  # BASELINE.json north star for this config
+    value = n_ops / dev_s
+    print(
+        json.dumps(
+            {
+                "metric": "ops_verified_per_sec_chip",
+                "value": round(value, 2),
+                "unit": "ops/s",
+                "vs_baseline": round(target_s / dev_s, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,8 +20,9 @@ Backends for ``check``:
                  accelerator handles what the CPU cannot).
 
 Exit codes: 0 linearizable, 1 not linearizable, 2 inconclusive, 64 usage /
-decode errors (the reference distinguishes only 0/1; UNKNOWN has no
-reference analog because Porcupine's timeout-0 runs are unbounded).
+decode errors (argparse usage errors included; the reference distinguishes
+only 0/1 — UNKNOWN has no reference analog because Porcupine's timeout-0
+runs are unbounded).
 """
 
 from __future__ import annotations
@@ -43,6 +44,23 @@ from .utils import events as ev
 __all__ = ["main"]
 
 log = logging.getLogger("s2_verification_tpu")
+
+USAGE_EXIT = 64
+
+
+def _umask() -> int:
+    cur = os.umask(0)
+    os.umask(cur)
+    return cur
+
+
+class _Parser(argparse.ArgumentParser):
+    """argparse exits 2 on usage errors, which would collide with the
+    'inconclusive' verdict; route usage errors to the documented 64."""
+
+    def error(self, message: str) -> None:  # noqa: D401 - argparse hook
+        self.print_usage(sys.stderr)
+        self.exit(USAGE_EXIT, f"{self.prog}: error: {message}\n")
 
 
 def _read_events(path: str) -> list[ev.LabeledEvent]:
@@ -95,7 +113,7 @@ def _cmd_check(args: argparse.Namespace) -> int:
     if not args.no_viz:
         # Always emit the visualization, success or not, like the reference
         # (main.go:608-631): porcupine-outputs/<base>-<unique>.html.
-        from .viz import render_html
+        from .viz import write_visualization
 
         full = prepare(events, elide_trivial=False)
         os.makedirs(args.out_dir, exist_ok=True)
@@ -103,15 +121,17 @@ def _cmd_check(args: argparse.Namespace) -> int:
         fd, path = tempfile.mkstemp(
             prefix=f"{base}-", suffix=".html", dir=args.out_dir
         )
-        with os.fdopen(fd, "w", encoding="utf-8") as f:
-            f.write(
-                render_html(
-                    full,
-                    res,
-                    title=f"s2 linearizability check — {base}",
-                    checked=checked,
-                )
-            )
+        os.close(fd)
+        # mkstemp reserves a unique name but creates it 0600; the artifact
+        # is a report, not a secret.
+        os.chmod(path, 0o644 & ~_umask())
+        write_visualization(
+            path,
+            full,
+            res,
+            title=f"s2 linearizability check — {base}",
+            checked=checked,
+        )
         log.info("wrote visualization to %s", path)
 
     if res.outcome == CheckOutcome.OK:
@@ -148,7 +168,7 @@ def _cmd_collect(args: argparse.Namespace) -> int:
 
 
 def build_parser() -> argparse.ArgumentParser:
-    p = argparse.ArgumentParser(
+    p = _Parser(
         prog="s2-verification-tpu",
         description="TPU-native S2 linearizability verification framework",
     )
@@ -181,8 +201,18 @@ def build_parser() -> argparse.ArgumentParser:
     c.set_defaults(fn=_cmd_check)
 
     g = sub.add_parser("collect", help="collect a history against the fake S2")
-    g.add_argument("basin", nargs="?", default="local")
-    g.add_argument("stream", nargs="?", default="stream")
+    g.add_argument(
+        "basin",
+        nargs="?",
+        default="local",
+        help="ignored (collection runs against the in-process fake S2)",
+    )
+    g.add_argument(
+        "stream",
+        nargs="?",
+        default="stream",
+        help="ignored (collection runs against the in-process fake S2)",
+    )
     g.add_argument("--num-concurrent-clients", type=int, default=5)
     g.add_argument("--num-ops-per-client", type=int, default=100)
     g.add_argument(

@@ -141,3 +141,10 @@ def test_version_flag(capsys):
     with pytest.raises(SystemExit) as e:
         main(["-version"])
     assert e.value.code == 0
+
+
+def test_usage_error_exit64(capsys):
+    # Usage errors must not collide with exit 2 = inconclusive.
+    with pytest.raises(SystemExit) as e:
+        main(["check", "-backend", "bogus"])
+    assert e.value.code == 64
