@@ -1,0 +1,228 @@
+"""ctypes bridge to the native C++ Wing–Gong checker (native/s2check.cpp).
+
+The reference's CPU checking path is native (compiled Go + the porcupine
+library); this module gives the framework the same property.  The shared
+library is built lazily with ``make -C native`` on first use (g++; no
+third-party deps) and the verdict semantics are identical to
+:func:`..checker.oracle.check` — differential tests pin the two together.
+
+The native engine consumes the same :class:`~..models.encode.EncodedHistory`
+arrays as the device search, so host encode work is shared between backends.
+The linearization order it returns is over encoded ops; this wrapper maps it
+back to ``History.ops`` indices and prepends the forced prefix.
+"""
+
+from __future__ import annotations
+
+import ctypes as ct
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from ..models.encode import INF_TIME, encode_history, intern_state
+from ..models.stream import StreamState
+from .entries import History
+from .oracle import CheckOutcome, CheckResult
+
+__all__ = ["native_available", "check_native", "NativeUnavailable"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "_native",
+    "libs2check.so",
+)
+_lock = threading.Lock()
+_lib: ct.CDLL | None = None
+_build_error: str | None = None
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _u8(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, np.uint8)
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    src = os.path.join(_REPO, "native", "s2check.cpp")
+    # A stale .so silently shadowing a newer source is worse than a rebuild.
+    return os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(
+        _LIB_PATH
+    )
+
+
+def _load() -> ct.CDLL:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            raise NativeUnavailable(_build_error)
+        if _needs_build():
+            makefile = os.path.join(_REPO, "native", "Makefile")
+            if not os.path.exists(makefile):
+                _build_error = f"no prebuilt {_LIB_PATH} and no native/Makefile"
+                raise NativeUnavailable(_build_error)
+            proc = subprocess.run(
+                ["make", "-C", os.path.dirname(makefile)],
+                capture_output=True,
+                text=True,
+            )
+            if proc.returncode != 0:
+                _build_error = f"native build failed:\n{proc.stderr[-2000:]}"
+                raise NativeUnavailable(_build_error)
+        try:
+            lib = ct.CDLL(_LIB_PATH)
+            lib.s2_check.restype = ct.c_int32
+        except OSError as e:
+            # e.g. a wrong-arch .so copied in from another machine.
+            _build_error = f"cannot load {_LIB_PATH}: {e}"
+            raise NativeUnavailable(_build_error) from e
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def _ptr(a: np.ndarray, typ):
+    return a.ctypes.data_as(ct.POINTER(typ))
+
+
+def check_native(
+    history: History, time_budget_s: float | None = None
+) -> CheckResult:
+    """Decide linearizability with the native engine.
+
+    Verdict semantics match :func:`..checker.oracle.check`; ``deepest`` is
+    not reported (use the Python oracle for failure diagnostics).
+    """
+    lib = _load()
+    enc = encode_history(history)
+    if enc.total_remaining == 0 and enc.num_ops == 0:
+        return CheckResult(
+            CheckOutcome.OK,
+            linearization=list(enc.forced_prefix),
+            final_states=sorted(enc.init_states),
+        )
+    n = enc.num_ops
+
+    init = sorted(intern_state(enc, s) for s in enc.init_states)
+    init_tail = np.asarray([t for t, _, _, _ in init], np.uint32)
+    init_hash = np.asarray(
+        [(hi << 32) | lo for _, hi, lo, _ in init], np.uint64
+    )
+    init_tok = np.asarray([k for _, _, _, k in init], np.int32)
+
+    out_hash = (enc.out_hash_hi.astype(np.uint64) << np.uint64(32)) | enc.out_hash_lo.astype(
+        np.uint64
+    )
+    order = np.zeros(max(1, n), np.int32)
+    order_len = ct.c_int32(0)
+    states_cap = 4096
+    st_tail = np.zeros(states_cap, np.uint32)
+    st_hash = np.zeros(states_cap, np.uint64)
+    st_tok = np.zeros(states_cap, np.int32)
+    states_len = ct.c_int32(0)
+    steps = ct.c_int64(0)
+    hits = ct.c_int64(0)
+
+    i32, u32, u64, u8 = ct.c_int32, ct.c_uint32, ct.c_uint64, ct.c_uint8
+
+    def invoke():
+        return lib.s2_check(
+        ct.c_int32(n),
+        _ptr(np.ascontiguousarray(enc.op_type, np.int32), i32),
+        _ptr(_u8(enc.has_set_token), u8),
+        _ptr(np.ascontiguousarray(enc.set_token, np.int32), i32),
+        _ptr(_u8(enc.has_batch_token), u8),
+        _ptr(np.ascontiguousarray(enc.batch_token, np.int32), i32),
+        _ptr(_u8(enc.has_match), u8),
+        _ptr(np.ascontiguousarray(enc.match_seq, np.uint32), u32),
+        _ptr(np.ascontiguousarray(enc.num_records, np.uint32), u32),
+        _ptr(np.ascontiguousarray(enc.rh_row, np.int32), i32),
+        _ptr(np.ascontiguousarray(enc.rh_len, np.int32), i32),
+        ct.c_int32(enc.rh_hi.shape[1]),
+        _ptr(np.ascontiguousarray(enc.rh_hi, np.uint32), u32),
+        _ptr(np.ascontiguousarray(enc.rh_lo, np.uint32), u32),
+        _ptr(_u8(enc.out_failure), u8),
+        _ptr(_u8(enc.out_definite), u8),
+        _ptr(np.ascontiguousarray(enc.out_tail, np.uint32), u32),
+        _ptr(_u8(enc.out_has_hash), u8),
+        _ptr(np.ascontiguousarray(out_hash, np.uint64), u64),
+        _ptr(np.ascontiguousarray(enc.call, np.int32), i32),
+        _ptr(np.ascontiguousarray(enc.ret, np.int32), i32),
+        ct.c_int32(len(init)),
+        _ptr(init_tail, u32),
+        _ptr(init_hash, u64),
+        _ptr(init_tok, i32),
+        ct.c_double(-1.0 if time_budget_s is None else time_budget_s),
+        _ptr(order, i32),
+        ct.byref(order_len),
+        _ptr(st_tail, u32),
+        _ptr(st_hash, u64),
+        _ptr(st_tok, i32),
+            ct.c_int32(states_cap),
+            ct.byref(states_len),
+            ct.byref(steps),
+            ct.byref(hits),
+        )
+
+    rc = invoke()
+    if rc == 0 and states_len.value > states_cap:
+        # Final state set overflowed the buffer; re-run with room for all of
+        # it (rare: needs >4096 simultaneously-open ambiguous appends).
+        states_cap = int(states_len.value)
+        st_tail = np.zeros(states_cap, np.uint32)
+        st_hash = np.zeros(states_cap, np.uint64)
+        st_tok = np.zeros(states_cap, np.int32)
+        rc = invoke()
+        assert states_len.value <= states_cap
+
+    # Encoded op index → History.ops index (forced-prefix ops were peeled
+    # off before encoding).
+    forced_set = set(enc.forced_prefix)
+    keep_index = [op.index for op in history.ops if op.index not in forced_set]
+
+    if rc != 0:
+        outcome = CheckOutcome.UNKNOWN if rc == 2 else CheckOutcome.ILLEGAL
+        deepest = list(enc.forced_prefix) + [
+            keep_index[j] for j in order[: order_len.value]
+        ]
+        return CheckResult(
+            outcome,
+            deepest=deepest,
+            steps=int(steps.value),
+            cache_hits=int(hits.value),
+        )
+
+    lin = list(enc.forced_prefix) + [
+        keep_index[j] for j in order[: order_len.value]
+    ]
+    final = [
+        StreamState(
+            tail=int(st_tail[i]),
+            stream_hash=int(st_hash[i]),
+            fencing_token=enc.token_of_id[int(st_tok[i])],
+        )
+        for i in range(states_len.value)
+    ]
+    return CheckResult(
+        CheckOutcome.OK,
+        linearization=lin,
+        deepest=lin,
+        final_states=final,
+        steps=int(steps.value),
+        cache_hits=int(hits.value),
+    )

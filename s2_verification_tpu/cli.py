@@ -12,12 +12,14 @@
 
 Backends for ``check``:
 
-- ``oracle``   — Wing–Gong DFS with memoization (CPU; the default oracle).
+- ``oracle``   — Wing–Gong DFS with memoization (Python; the semantic oracle).
+- ``native``   — the same search compiled to native code (native/s2check.cpp),
+                 the reference's compiled-Go/porcupine equivalent.
 - ``frontier`` — host BFS frontier engine (CPU; the device twin's reference).
 - ``device``   — the compiled TPU frontier search.
-- ``auto``     — oracle with a time budget, escalating to the device search
-                 when the budget expires (CPU stays the default path; the
-                 accelerator handles what the CPU cannot).
+- ``auto``     — native (or oracle) with a time budget, escalating to the
+                 device search when the budget expires (CPU stays the default
+                 path; the accelerator handles what the CPU cannot).
 
 Exit codes: 0 linearizable, 1 not linearizable, 2 inconclusive, 64 usage /
 decode errors (argparse usage errors included; the reference distinguishes
@@ -69,11 +71,26 @@ def _read_events(path: str) -> list[ev.LabeledEvent]:
     return ev.read_history(path)
 
 
+def _cpu_check(hist: History, budget: float | None) -> CheckResult:
+    """Native engine when buildable, Python oracle otherwise."""
+    from .checker.native import NativeUnavailable, check_native
+
+    try:
+        return check_native(hist, time_budget_s=budget)
+    except NativeUnavailable as e:
+        log.debug("native checker unavailable (%s); using the Python oracle", e)
+        return check(hist, time_budget_s=budget)
+
+
 def _run_backend(
     backend: str, hist: History, time_budget_s: float | None
 ) -> CheckResult:
     if backend == "oracle":
         return check(hist, time_budget_s=time_budget_s)
+    if backend == "native":
+        from .checker.native import check_native
+
+        return check_native(hist, time_budget_s=time_budget_s)
     if backend == "frontier":
         from .checker.frontier import check_frontier_auto
 
@@ -84,10 +101,13 @@ def _run_backend(
         return check_device_auto(hist)
     if backend == "auto":
         budget = time_budget_s if time_budget_s is not None else 10.0
-        res = check(hist, time_budget_s=budget)
+        res = _cpu_check(hist, budget)
         if res.outcome != CheckOutcome.UNKNOWN:
             return res
-        log.info("oracle hit its %.1fs budget; escalating to the device search", budget)
+        log.info(
+            "CPU engine hit its %.1fs budget; escalating to the device search",
+            budget,
+        )
         from .checker.device import check_device_auto
 
         return check_device_auto(hist)
@@ -107,7 +127,15 @@ def _cmd_check(args: argparse.Namespace) -> int:
         return 64
 
     t0 = time.monotonic()
-    res = _run_backend(args.backend, checked, args.time_budget)
+    try:
+        res = _run_backend(args.backend, checked, args.time_budget)
+    except Exception as e:  # backend/environment failure, not a verdict
+        from .checker.native import NativeUnavailable
+
+        if isinstance(e, NativeUnavailable):
+            log.error("native backend unavailable: %s", e)
+            return USAGE_EXIT
+        raise
     dt = time.monotonic() - t0
 
     if not args.no_viz:
@@ -185,7 +213,7 @@ def build_parser() -> argparse.ArgumentParser:
         "-backend",
         "--backend",
         default="auto",
-        choices=["oracle", "frontier", "device", "auto"],
+        choices=["oracle", "native", "frontier", "device", "auto"],
     )
     c.add_argument(
         "-time-budget",

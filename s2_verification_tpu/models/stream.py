@@ -65,6 +65,23 @@ class StreamState(NamedTuple):
     #: None means "no fencing token set"; distinct from the empty string.
     fencing_token: str | None
 
+    def __lt__(self, other) -> bool:  # type: ignore[override]
+        # Total order even when a tail/hash tie mixes None and str tokens
+        # (plain tuple comparison would raise TypeError on None < str).
+        if not isinstance(other, StreamState):
+            return NotImplemented
+        return (
+            self.tail,
+            self.stream_hash,
+            self.fencing_token is not None,
+            self.fencing_token or "",
+        ) < (
+            other.tail,
+            other.stream_hash,
+            other.fencing_token is not None,
+            other.fencing_token or "",
+        )
+
 
 INIT_STATE = StreamState(tail=0, stream_hash=0, fencing_token=None)
 

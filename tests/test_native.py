@@ -1,0 +1,130 @@
+"""Differential tests: native C++ checker vs the Python oracle.
+
+The native engine (native/s2check.cpp via checker/native.py) must agree with
+checker/oracle.py verdict-for-verdict — the same relationship the reference
+has between its Go model tests and the compiled porcupine search.
+"""
+
+import random
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.checker.native import check_native, native_available
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.models.stream import step_set
+from test_oracle_bruteforce import random_history
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library not buildable"
+)
+
+BATCH = [11, 22, 33]
+
+
+def test_native_matches_oracle_on_random_histories():
+    rng = random.Random(0xC0FFEE)
+    for trial in range(400):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        want = check(hist)
+        got = check_native(hist)
+        assert got.outcome == want.outcome, f"trial {trial}"
+        if want.ok:
+            assert sorted(got.final_states) == sorted(want.final_states), (
+                f"trial {trial}"
+            )
+
+
+def test_native_linearization_replays():
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=25,
+            workflow="fencing",
+            seed=21,
+            faults=FaultPlan.chaos(0.25),
+        )
+    )
+    hist = prepare(events)
+    res = check_native(hist)
+    assert res.ok
+    assert sorted(res.linearization) == list(range(len(hist.ops)))
+    # Replaying the full order through the model must keep the state set
+    # non-empty and land on the reported final states.
+    states = None
+    from s2_verification_tpu.models.stream import INIT_STATE
+
+    states = [INIT_STATE]
+    for idx in res.linearization:
+        op = hist.ops[idx]
+        states = step_set(states, op.inp, op.out)
+        assert states, f"order dies at op {idx}"
+    assert sorted(states) == sorted(res.final_states)
+
+
+def test_native_rejects_corrupted_prefix():
+    # TestReadDetectsCorruptedPrefix (main_test.go:317-342): right tail,
+    # right last batch, corrupted earlier prefix hash.
+    h = H()
+    h.append_ok(1, BATCH, tail=3)
+    h.append_ok(1, [44], tail=4)
+    bad = fold([99, 98, 97] + [44])
+    h.read_ok(1, tail=4, stream_hash=bad)
+    assert check_native(prepare(h.events)).outcome == CheckOutcome.ILLEGAL
+
+
+def test_native_time_budget_returns_unknown_or_verdict():
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=5,
+            num_ops_per_client=40,
+            workflow="regular",
+            seed=3,
+            faults=FaultPlan.chaos(0.2),
+        )
+    )
+    hist = prepare(events)
+    res = check_native(hist, time_budget_s=1e-9)
+    assert res.outcome in (CheckOutcome.UNKNOWN, CheckOutcome.OK, CheckOutcome.ILLEGAL)
+    full = check_native(hist)
+    assert full.outcome == check(hist).outcome
+
+
+def test_native_empty_history():
+    res = check_native(prepare([]))
+    assert res.ok and res.final_states
+
+
+def test_native_deepest_matches_oracle_on_illegal():
+    h = H()
+    h.append_ok(1, BATCH, tail=3)
+    h.append_ok(1, [44], tail=4)
+    h.read_ok(1, tail=4, stream_hash=fold([99, 98, 97, 44]))
+    hist = prepare(h.events)
+    rn, ro = check_native(hist), check(hist)
+    assert rn.outcome == ro.outcome == CheckOutcome.ILLEGAL
+    assert sorted(rn.deepest) == sorted(ro.deepest)
+
+
+def test_mixed_token_states_sort():
+    # A tail/hash tie between a None-token and a str-token state must not
+    # raise (plain tuple ordering would compare None < str).
+    h = H()
+    h.append_indefinite_fail(1, [], set_token="x")
+    hist = prepare(h.events)
+    rn, ro = check_native(hist), check(hist)
+    assert rn.outcome == ro.outcome == CheckOutcome.OK
+    assert sorted(rn.final_states) == sorted(ro.final_states)
+
+
+def test_native_stats_populated():
+    events = collect_history(
+        CollectConfig(num_concurrent_clients=2, num_ops_per_client=10, seed=1)
+    )
+    hist = prepare(events)
+    res = check_native(hist)
+    assert res.ok and res.steps > 0
