@@ -1,0 +1,133 @@
+"""Checkpoint/resume for long device searches.
+
+The reference has no checkpointing — its persistent artifact is the JSONL
+history and checking is one-shot in-memory (SURVEY.md §5).  Long frontier
+searches on device deserve better: the whole search state is one dense
+:class:`~.device.Frontier` plus a few counters, so a snapshot is a single
+``.npz`` write, and resuming is exactly the capacity-escalation path the
+driver already exercises.
+
+A checkpoint is bound to its history by a fingerprint over the encoded
+arrays; resuming against a different history raises.  Writes are atomic
+(tmp + rename) so a crash mid-write never corrupts the previous snapshot.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.encode import EncodedHistory
+
+__all__ = ["history_fingerprint", "save_checkpoint", "load_checkpoint", "Checkpoint"]
+
+_FORMAT = 1
+
+
+def history_fingerprint(enc: EncodedHistory) -> str:
+    """Stable digest of everything the search semantics depend on."""
+    h = hashlib.sha256()
+    for name in (
+        "op_type",
+        "has_set_token",
+        "set_token",
+        "has_batch_token",
+        "batch_token",
+        "has_match",
+        "match_seq",
+        "num_records",
+        "rh_row",
+        "rh_len",
+        "out_failure",
+        "out_definite",
+        "out_tail",
+        "out_has_hash",
+        "out_hash_hi",
+        "out_hash_lo",
+        "call",
+        "ret",
+        "chain_of",
+        "rh_hi",
+        "rh_lo",
+        "chain_ops",
+        "chain_len",
+        "chain_start",
+    ):
+        arr = np.ascontiguousarray(getattr(enc, name))
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    for s in sorted(enc.init_states):
+        h.update(repr(s).encode())
+    return h.hexdigest()
+
+
+@dataclass
+class Checkpoint:
+    fingerprint: str
+    #: frontier arrays, host-side
+    counts: np.ndarray
+    tail: np.ndarray
+    hi: np.ndarray
+    lo: np.ndarray
+    tok: np.ndarray
+    svalid: np.ndarray
+    valid: np.ndarray
+    #: driver state
+    f: int
+    beam: bool
+    layers_done: int
+    stats: dict
+
+
+def save_checkpoint(path: str, ckpt: Checkpoint) -> None:
+    meta = {
+        "format": _FORMAT,
+        "fingerprint": ckpt.fingerprint,
+        "f": int(ckpt.f),
+        "beam": bool(ckpt.beam),
+        "layers_done": int(ckpt.layers_done),
+        "stats": ckpt.stats,
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        np.savez_compressed(
+            fh,
+            meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+            counts=ckpt.counts,
+            tail=ckpt.tail,
+            hi=ckpt.hi,
+            lo=ckpt.lo,
+            tok=ckpt.tok,
+            svalid=ckpt.svalid,
+            valid=ckpt.valid,
+        )
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str) -> Checkpoint:
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["meta"]).decode())
+        if meta.get("format") != _FORMAT:
+            raise ValueError(
+                f"checkpoint {path} has format {meta.get('format')}, want {_FORMAT}"
+            )
+        return Checkpoint(
+            fingerprint=meta["fingerprint"],
+            counts=z["counts"],
+            tail=z["tail"],
+            hi=z["hi"],
+            lo=z["lo"],
+            tok=z["tok"],
+            svalid=z["svalid"],
+            valid=z["valid"],
+            f=int(meta["f"]),
+            beam=bool(meta["beam"]),
+            layers_done=int(meta["layers_done"]),
+            stats=dict(meta["stats"]),
+        )

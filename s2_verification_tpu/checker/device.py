@@ -54,6 +54,8 @@ main.go:264-335 (see ops/step_kernel.py).
 
 from __future__ import annotations
 
+import contextlib
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -550,6 +552,8 @@ def check_device(
     start_frontier: int = 64,
     mesh=None,
     collect_stats: bool = False,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 512,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -566,6 +570,12 @@ def check_device(
     reachable — but ``final_states`` may then be a subset of the host
     engine's).  ``stats.pruned`` records that this happened
     (``collect_stats=True``).
+
+    ``checkpoint_path``: snapshot the search frontier to this file every
+    ``checkpoint_every`` layers (and at capacity escalations) so a long
+    search survives preemption; an existing snapshot for the *same* history
+    is resumed from, and a conclusive verdict removes it.  A new capability
+    over the reference, whose checking is one-shot in-memory (SURVEY.md §5).
     """
     enc = encode_history(history)
     stats = FrontierStats()
@@ -579,21 +589,99 @@ def check_device(
             res.stats = stats  # type: ignore[attr-defined]
         return res
     tables = build_tables(enc)
-    cap_layers = np.int32(enc.total_remaining + 2)
+    cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
     f = _round_pow2(min(start_frontier, f_cap), 2)
     s = _round_pow2(max(len(enc.init_states), state_slots), 2)
     max_state_slots = 256
-    frontier = init_frontier(enc, f, s)
+    layers_done = 0
+    frontier = None
+
+    if checkpoint_path is not None:
+        import dataclasses
+
+        from .checkpoint import (
+            Checkpoint,
+            history_fingerprint,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        fingerprint = history_fingerprint(enc)
+        if os.path.exists(checkpoint_path):
+            ck = load_checkpoint(checkpoint_path)
+            if ck.fingerprint != fingerprint:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} belongs to a different "
+                    "history (fingerprint mismatch)"
+                )
+            if ck.beam != beam:
+                # A pruned beam frontier must never seed an exhaustive pass
+                # (its dead ends would be inconclusive forever), and vice
+                # versa a wider exhaustive frontier under beam rules skews
+                # stats; refuse rather than silently degrade.
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} was written by a "
+                    f"{'beam' if ck.beam else 'exhaustive'} search and cannot "
+                    f"resume a {'beam' if beam else 'exhaustive'} one"
+                )
+            f = ck.f
+            layers_done = ck.layers_done
+            for k, v in ck.stats.items():
+                setattr(stats, k, v)
+            frontier = Frontier(
+                counts=jnp.asarray(ck.counts),
+                tail=jnp.asarray(ck.tail),
+                hi=jnp.asarray(ck.hi),
+                lo=jnp.asarray(ck.lo),
+                tok=jnp.asarray(ck.tok),
+                svalid=jnp.asarray(ck.svalid),
+                valid=jnp.asarray(ck.valid),
+            )
+
+        def _snapshot(fr: Frontier) -> None:
+            save_checkpoint(
+                checkpoint_path,
+                Checkpoint(
+                    fingerprint=fingerprint,
+                    counts=np.asarray(fr.counts),
+                    tail=np.asarray(fr.tail),
+                    hi=np.asarray(fr.hi),
+                    lo=np.asarray(fr.lo),
+                    tok=np.asarray(fr.tok),
+                    svalid=np.asarray(fr.svalid),
+                    valid=np.asarray(fr.valid),
+                    f=f,
+                    beam=beam,
+                    layers_done=layers_done,
+                    stats=dataclasses.asdict(stats),
+                ),
+            )
+
+    def _requeue(fr_np: Frontier, *, snapshot: bool) -> Frontier:
+        """Snapshot a host-side frontier and hand it back to the device."""
+        if snapshot and checkpoint_path is not None:
+            _snapshot(fr_np)
+        dev_fr = jax.tree.map(jnp.asarray, fr_np)
+        return place_frontier(dev_fr, mesh) if mesh is not None else dev_fr
+
+    if frontier is None:
+        frontier = init_frontier(enc, f, s)
     if mesh is not None:
         frontier = place_frontier(frontier, mesh)
 
     while True:
         allow_prune = beam and f >= f_cap
+        layers_budget = cap_layers - layers_done
+        if checkpoint_path is not None and checkpoint_every > 0:
+            layers_budget = min(layers_budget, checkpoint_every)
         out = jax.device_get(
-            run_search(tables, frontier, cap_layers, allow_prune=allow_prune)
+            run_search(
+                tables, frontier, np.int32(layers_budget), allow_prune=allow_prune
+            )
         )
+        layers_done += int(out.layers)
         stats.layers += int(out.layers)
         stats.max_frontier = max(stats.max_frontier, int(out.max_live))
         stats.max_state_set = max(stats.max_state_set, int(out.max_state_set))
@@ -640,17 +728,22 @@ def check_device(
                 stats.pruned = True
                 res = CheckResult(CheckOutcome.UNKNOWN)
                 break
-            frontier = (
-                place_frontier(jax.tree.map(jnp.asarray, resume), mesh)
-                if mesh is not None
-                else jax.tree.map(jnp.asarray, resume)
-            )
+            frontier = _requeue(resume, snapshot=True)
+            continue
+        if code == STOP_RUNNING and layers_done < cap_layers:
+            # Chunk boundary (checkpoint cadence): snapshot and keep going
+            # from the returned post-expansion frontier.
+            nxt = Frontier(*(np.asarray(x) for x in out.frontier))
+            frontier = _requeue(nxt, snapshot=True)
             continue
         # Layer cap hit without a verdict: should be impossible (each layer
         # linearizes exactly one op); treat as inconclusive.
         res = CheckResult(CheckOutcome.UNKNOWN)
         break
 
+    if checkpoint_path is not None and res.outcome != CheckOutcome.UNKNOWN:
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(checkpoint_path)
     if collect_stats:
         res.stats = stats  # type: ignore[attr-defined]
     return res
@@ -695,9 +788,15 @@ def check_device_auto(
     state_slots: int = 8,
     mesh=None,
     collect_stats: bool = False,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 512,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
-    :func:`..checker.frontier.check_frontier_auto`."""
+    :func:`..checker.frontier.check_frontier_auto`.
+
+    The beam and exhaustive phases use distinct checkpoint files (a beam
+    snapshot must not resume an exhaustive pass, whose soundness rules
+    differ)."""
     res = check_device(
         history,
         max_frontier=beam_width,
@@ -705,9 +804,18 @@ def check_device_auto(
         beam=True,
         mesh=mesh,
         collect_stats=collect_stats,
+        checkpoint_path=(
+            f"{checkpoint_path}.beam" if checkpoint_path is not None else None
+        ),
+        checkpoint_every=checkpoint_every,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
+    if checkpoint_path is not None:
+        # The conceded beam phase's snapshot must not linger: it would
+        # fingerprint-clash with the next history checked under this path.
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(f"{checkpoint_path}.beam")
     return check_device(
         history,
         max_frontier=exhaustive_cap,
@@ -715,4 +823,6 @@ def check_device_auto(
         beam=False,
         mesh=mesh,
         collect_stats=collect_stats,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every,
     )

@@ -83,8 +83,17 @@ def _cpu_check(hist: History, budget: float | None) -> CheckResult:
 
 
 def _run_backend(
-    backend: str, hist: History, time_budget_s: float | None
+    backend: str,
+    hist: History,
+    time_budget_s: float | None,
+    checkpoint: str | None = None,
 ) -> CheckResult:
+    if checkpoint is not None and backend not in ("device", "auto"):
+        log.warning(
+            "-checkpoint only applies to the device search; the %s backend "
+            "will not snapshot",
+            backend,
+        )
     if backend == "oracle":
         return check(hist, time_budget_s=time_budget_s)
     if backend == "native":
@@ -98,7 +107,7 @@ def _run_backend(
     if backend == "device":
         from .checker.device import check_device_auto
 
-        return check_device_auto(hist)
+        return check_device_auto(hist, checkpoint_path=checkpoint)
     if backend == "auto":
         budget = time_budget_s if time_budget_s is not None else 10.0
         res = _cpu_check(hist, budget)
@@ -110,7 +119,7 @@ def _run_backend(
         )
         from .checker.device import check_device_auto
 
-        return check_device_auto(hist)
+        return check_device_auto(hist, checkpoint_path=checkpoint)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -128,7 +137,9 @@ def _cmd_check(args: argparse.Namespace) -> int:
 
     t0 = time.monotonic()
     try:
-        res = _run_backend(args.backend, checked, args.time_budget)
+        res = _run_backend(
+            args.backend, checked, args.time_budget, checkpoint=args.checkpoint
+        )
     except Exception as e:  # backend/environment failure, not a verdict
         from .checker.native import NativeUnavailable
 
@@ -223,6 +234,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="oracle time budget in seconds (auto backend default: 10)",
     )
     c.add_argument("-out-dir", "--out-dir", default="./porcupine-outputs")
+    c.add_argument(
+        "-checkpoint",
+        "--checkpoint",
+        default=None,
+        help="snapshot file for long device searches (resume + preemption safety)",
+    )
     c.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
     )

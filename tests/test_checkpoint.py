@@ -1,0 +1,153 @@
+"""Checkpoint/resume tests for the device search.
+
+A new capability over the reference (SURVEY.md §5: checking is one-shot
+in-memory there): long searches snapshot their frontier and resume exactly.
+"""
+
+import os
+
+import pytest
+
+from s2_verification_tpu.checker.checkpoint import (
+    Checkpoint,
+    history_fingerprint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from s2_verification_tpu.checker.device import check_device
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.models.encode import encode_history
+
+
+@pytest.fixture(scope="module")
+def hist():
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=25,
+            workflow="regular",
+            seed=13,
+            faults=FaultPlan.chaos(0.15),
+        )
+    )
+    return prepare(events)
+
+
+def test_fingerprint_stable_and_sensitive(hist):
+    enc1 = encode_history(hist)
+    enc2 = encode_history(hist)
+    assert history_fingerprint(enc1) == history_fingerprint(enc2)
+    enc2.out_tail = enc2.out_tail.copy()
+    if enc2.num_ops:
+        enc2.out_tail[0] ^= 1
+        assert history_fingerprint(enc1) != history_fingerprint(enc2)
+
+
+def test_checkpointed_run_matches_plain(hist, tmp_path):
+    ck = str(tmp_path / "search.ckpt")
+    want = check(hist).outcome
+    got = check_device(
+        hist, beam=False, max_frontier=256, checkpoint_path=ck, checkpoint_every=5
+    )
+    assert got.outcome == want
+    # Conclusive verdict removes the snapshot.
+    assert not os.path.exists(ck)
+
+
+def test_resume_from_snapshot(hist, tmp_path):
+    """Interrupt a chunked search mid-way, then resume to the same verdict."""
+    ck = str(tmp_path / "search.ckpt")
+    enc = encode_history(hist)
+    want = check(hist).outcome
+
+    calls = {"n": 0}
+    import s2_verification_tpu.checker.device as dev
+
+    real_run = dev.run_search
+
+    def interrupting(*a, **kw):
+        calls["n"] += 1
+        out = real_run(*a, **kw)
+        if calls["n"] == 3:
+            raise KeyboardInterrupt  # simulated preemption after 3 chunks
+        return out
+
+    dev.run_search = interrupting
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            check_device(
+                hist,
+                beam=False,
+                max_frontier=256,
+                checkpoint_path=ck,
+                checkpoint_every=4,
+            )
+    finally:
+        dev.run_search = real_run
+
+    assert os.path.exists(ck)
+    saved = load_checkpoint(ck)
+    assert saved.layers_done >= 8  # at least two completed chunks
+    assert saved.fingerprint == history_fingerprint(enc)
+
+    res = check_device(
+        hist, beam=False, max_frontier=256, checkpoint_path=ck, checkpoint_every=4
+    )
+    assert res.outcome == want
+    assert not os.path.exists(ck)
+
+
+def test_beam_snapshot_cannot_resume_exhaustive(hist, tmp_path):
+    ck = str(tmp_path / "search.ckpt")
+    import s2_verification_tpu.checker.device as dev
+
+    real_run = dev.run_search
+    calls = {"n": 0}
+
+    def interrupting(*a, **kw):
+        calls["n"] += 1
+        out = real_run(*a, **kw)
+        if calls["n"] == 2:
+            raise KeyboardInterrupt
+        return out
+
+    dev.run_search = interrupting
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            check_device(
+                hist, beam=True, checkpoint_path=ck, checkpoint_every=3
+            )
+    finally:
+        dev.run_search = real_run
+    assert os.path.exists(ck)
+    with pytest.raises(ValueError, match="beam"):
+        check_device(hist, beam=False, checkpoint_path=ck)
+
+
+def test_mismatched_history_rejected(hist, tmp_path):
+    ck = str(tmp_path / "search.ckpt")
+    enc = encode_history(hist)
+    import numpy as np
+
+    save_checkpoint(
+        ck,
+        Checkpoint(
+            fingerprint="deadbeef",
+            counts=np.zeros((2, enc.num_chains), np.int32),
+            tail=np.zeros((2, 2), np.uint32),
+            hi=np.zeros((2, 2), np.uint32),
+            lo=np.zeros((2, 2), np.uint32),
+            tok=np.zeros((2, 2), np.int32),
+            svalid=np.zeros((2, 2), bool),
+            valid=np.zeros(2, bool),
+            f=2,
+            beam=False,
+            layers_done=0,
+            stats={},
+        ),
+    )
+    with pytest.raises(ValueError, match="fingerprint"):
+        check_device(hist, beam=False, checkpoint_path=ck)
