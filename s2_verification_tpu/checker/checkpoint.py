@@ -14,18 +14,30 @@ arrays; resuming against a different history raises.  Writes are atomic
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
+import zipfile
 from dataclasses import dataclass
 
 import numpy as np
 
 from ..models.encode import EncodedHistory
 
-__all__ = ["history_fingerprint", "save_checkpoint", "load_checkpoint", "Checkpoint"]
+__all__ = [
+    "history_fingerprint",
+    "save_checkpoint",
+    "load_checkpoint",
+    "Checkpoint",
+    "CheckpointError",
+]
 
 _FORMAT = 1
+
+
+class CheckpointError(ValueError):
+    """A snapshot is unreadable or does not belong to this search."""
 
 
 def history_fingerprint(enc: EncodedHistory) -> str:
@@ -95,39 +107,52 @@ def save_checkpoint(path: str, ckpt: Checkpoint) -> None:
         "stats": ckpt.stats,
     }
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as fh:
-        np.savez_compressed(
-            fh,
-            meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
-            counts=ckpt.counts,
-            tail=ckpt.tail,
-            hi=ckpt.hi,
-            lo=ckpt.lo,
-            tok=ckpt.tok,
-            svalid=ckpt.svalid,
-            valid=ckpt.valid,
-        )
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez_compressed(
+                fh,
+                meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+                counts=ckpt.counts,
+                tail=ckpt.tail,
+                hi=ckpt.hi,
+                lo=ckpt.lo,
+                tok=ckpt.tok,
+                svalid=ckpt.svalid,
+                valid=ckpt.valid,
+            )
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
 
 
 def load_checkpoint(path: str) -> Checkpoint:
-    with np.load(path) as z:
-        meta = json.loads(bytes(z["meta"]).decode())
-        if meta.get("format") != _FORMAT:
-            raise ValueError(
-                f"checkpoint {path} has format {meta.get('format')}, want {_FORMAT}"
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode())
+            if meta.get("format") != _FORMAT:
+                raise CheckpointError(
+                    f"checkpoint {path} has format {meta.get('format')}, "
+                    f"want {_FORMAT}"
+                )
+            return Checkpoint(
+                fingerprint=meta["fingerprint"],
+                counts=z["counts"],
+                tail=z["tail"],
+                hi=z["hi"],
+                lo=z["lo"],
+                tok=z["tok"],
+                svalid=z["svalid"],
+                valid=z["valid"],
+                f=int(meta["f"]),
+                beam=bool(meta["beam"]),
+                layers_done=int(meta["layers_done"]),
+                stats=dict(meta["stats"]),
             )
-        return Checkpoint(
-            fingerprint=meta["fingerprint"],
-            counts=z["counts"],
-            tail=z["tail"],
-            hi=z["hi"],
-            lo=z["lo"],
-            tok=z["tok"],
-            svalid=z["svalid"],
-            valid=z["valid"],
-            f=int(meta["f"]),
-            beam=bool(meta["beam"]),
-            layers_done=int(meta["layers_done"]),
-            stats=dict(meta["stats"]),
-        )
+    except CheckpointError:
+        raise
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+        # Truncated/corrupt archives surface as zipfile/pickle/KeyError
+        # noise; normalize so callers can handle one exception type.
+        raise CheckpointError(f"cannot read checkpoint {path}: {e}") from e

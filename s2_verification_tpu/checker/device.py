@@ -264,6 +264,51 @@ def _auto_close_one(tables: SearchTables, counts, st_tail, st_tok, svalid, cfg_v
     return closed, (closed - counts).sum()
 
 
+def _canon_states(t, h, l, k, v, s):
+    """Dedup + canonically sort one candidate state set into ``s`` slots.
+
+    Inputs are flat arrays of 2S successor states (+ validity); returns the
+    sorted, zero-padded set plus an overflow flag (more than ``s`` distinct
+    valid states)."""
+    n2 = t.shape[0]
+    eqm = (
+        (t[:, None] == t[None, :])
+        & (h[:, None] == h[None, :])
+        & (l[:, None] == l[None, :])
+        & (k[:, None] == k[None, :])
+    )
+    lower = jnp.tril(jnp.ones((n2, n2), bool), -1)  # [i, j] = j < i
+    dup = (eqm & lower & v[None, :]).any(axis=1)
+    keep = v & ~dup
+    order = jnp.lexsort((k.astype(_U32), l, h, t, (~keep).astype(_I32)))
+    keep_s = keep[order][:s]
+    z = lambda x: jnp.where(keep_s, x[order][:s], 0)
+    return (
+        z(t),
+        z(h),
+        z(l),
+        jnp.where(keep_s, k[order][:s].astype(_I32), 0),
+        keep_s,
+        keep.sum() > s,
+    )
+
+
+def _step_states(tables: SearchTables, o, st_tail, st_hi, st_lo, st_tok, svalid):
+    """Apply op ``o`` to a candidate state set; returns the flat 2S successor
+    candidates (optimistic + no-effect branches) with validity."""
+
+    def per_state(t, h, l, k):
+        return step_kernel(tables.ops, o, DeviceState(t, h, l, k))
+
+    a, va, b, vb = jax.vmap(per_state)(st_tail, st_hi, st_lo, st_tok)
+    t2 = jnp.concatenate([a.tail, b.tail])
+    h2 = jnp.concatenate([a.hash_hi, b.hash_hi])
+    l2 = jnp.concatenate([a.hash_lo, b.hash_lo])
+    k2 = jnp.concatenate([a.token, b.token])
+    v2 = jnp.concatenate([va & svalid, vb & svalid])
+    return t2, h2, l2, k2, v2
+
+
 def _expand_one(tables: SearchTables, counts, st_tail, st_hi, st_lo, st_tok, svalid, cfg_valid):
     """All children of one configuration: one per candidate chain.
 
@@ -274,45 +319,13 @@ def _expand_one(tables: SearchTables, counts, st_tail, st_hi, st_lo, st_tok, sva
     s = st_tail.shape[0]
     nxt, cand = _next_and_cands(tables, counts)
 
-    def step_chain(o):
-        def per_state(t, h, l, k):
-            return step_kernel(tables.ops, o, DeviceState(t, h, l, k))
+    t2, h2, l2, k2, v2 = jax.vmap(
+        lambda o: _step_states(tables, o, st_tail, st_hi, st_lo, st_tok, svalid)
+    )(nxt)  # [C, 2S] each
 
-        return jax.vmap(per_state)(st_tail, st_hi, st_lo, st_tok)
-
-    a, va, b, vb = jax.vmap(step_chain)(nxt)  # DeviceState [C,S], bool [C,S] ×2
-
-    # Two candidate successors per source state; dedup + canonicalize per chain.
-    t2 = jnp.concatenate([a.tail, b.tail], axis=1)  # [C, 2S]
-    h2 = jnp.concatenate([a.hash_hi, b.hash_hi], axis=1)
-    l2 = jnp.concatenate([a.hash_lo, b.hash_lo], axis=1)
-    k2 = jnp.concatenate([a.token, b.token], axis=1)
-    v2 = jnp.concatenate([va & svalid[None, :], vb & svalid[None, :]], axis=1)
-
-    def canon_row(t, h, l, k, v):
-        n2 = t.shape[0]
-        eqm = (
-            (t[:, None] == t[None, :])
-            & (h[:, None] == h[None, :])
-            & (l[:, None] == l[None, :])
-            & (k[:, None] == k[None, :])
-        )
-        lower = jnp.tril(jnp.ones((n2, n2), bool), -1)  # [i, j] = j < i
-        dup = (eqm & lower & v[None, :]).any(axis=1)
-        keep = v & ~dup
-        order = jnp.lexsort((k.astype(_U32), l, h, t, (~keep).astype(_I32)))
-        keep_s = keep[order][:s]
-        z = lambda x: jnp.where(keep_s, x[order][:s], 0)
-        return (
-            z(t),
-            z(h),
-            z(l),
-            jnp.where(keep_s, k[order][:s].astype(_I32), 0),
-            keep_s,
-            keep.sum() > s,
-        )
-
-    ct, ch, cl, ck, cv, over = jax.vmap(canon_row)(t2, h2, l2, k2, v2)
+    ct, ch, cl, ck, cv, over = jax.vmap(partial(_canon_states, s=s))(
+        t2, h2, l2, k2, v2
+    )
     child_counts = counts[None, :] + jnp.eye(c, dtype=_I32)
     child_valid = cfg_valid & cand & cv.any(axis=1)
     overflow = (child_valid & over).any()
@@ -322,6 +335,54 @@ def _expand_one(tables: SearchTables, counts, st_tail, st_hi, st_lo, st_tok, sva
 def _accept_one(tables: SearchTables, counts, cfg_valid):
     c = counts.shape[0]
     return cfg_valid & tables.accept_tab[jnp.arange(c), counts].all()
+
+
+def _fast_layer(tables: SearchTables, frontier: Frontier):
+    """One forced step on the unique live configuration.
+
+    Precondition (checked by the caller): exactly one configuration is live
+    and its candidate window holds exactly one chain.  The single child
+    needs no cross-configuration dedup or compaction, so the layer skips
+    the frontier-wide lexsorts — the dominant cost on the long sequential
+    stretches of collector histories.  Return signature matches
+    :func:`_expand_layer`.
+    """
+    s = frontier.state_slots
+    idx = jnp.argmax(frontier.valid)
+    counts = frontier.counts[idx]
+    nxt, cand = _next_and_cands(tables, counts)
+    chain = jnp.argmax(cand)
+    o = nxt[chain]
+    t2, h2, l2, k2, v2 = _step_states(
+        tables,
+        o,
+        frontier.tail[idx],
+        frontier.hi[idx],
+        frontier.lo[idx],
+        frontier.tok[idx],
+        frontier.svalid[idx],
+    )
+    ct, ch, cl, ck, cv, over = _canon_states(t2, h2, l2, k2, v2, s)
+    child_valid = cv.any()
+    children = Frontier(
+        counts=frontier.counts.at[idx, chain].add(1),
+        tail=frontier.tail.at[idx].set(ct),
+        hi=frontier.hi.at[idx].set(ch),
+        lo=frontier.lo.at[idx].set(cl),
+        tok=frontier.tok.at[idx].set(ck),
+        svalid=frontier.svalid.at[idx].set(cv),
+        valid=frontier.valid.at[idx].set(child_valid),
+    )
+    n_unique = child_valid.astype(_I32)
+    mss = cv.sum().astype(_I32)
+    return (
+        children,
+        jnp.zeros((), bool),
+        over & child_valid,
+        n_unique,
+        jnp.ones((), _I32),
+        mss,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -433,11 +494,19 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         accept_any = acc_row.any()
 
         def do_expand(fr):
-            return _expand_layer(tables, fr)
+            return lax.cond(
+                fastable, partial(_fast_layer, tables), partial(_expand_layer, tables), fr
+            )
 
         def no_expand(fr):
             zero = jnp.zeros((), _I32)
             return fr, jnp.zeros((), bool), jnp.zeros((), bool), zero, zero, zero
+
+        # Fast path: a lone live configuration with a single-chain candidate
+        # window — the forced-step regime of low-concurrency stretches.
+        live_idx = jnp.argmax(closed.valid)
+        _, cand1 = _next_and_cands(tables, closed.counts[live_idx])
+        fastable = (closed.valid.sum() == 1) & (cand1.sum() == 1)
 
         children, pruned, overflow, n_unique, expanded, mss = lax.cond(
             accept_any, no_expand, do_expand, closed
@@ -547,9 +616,9 @@ def check_device(
     history: History,
     *,
     max_frontier: int = 4096,
-    state_slots: int = 8,
+    state_slots: int = 4,
     beam: bool = True,
-    start_frontier: int = 64,
+    start_frontier: int = 16,
     mesh=None,
     collect_stats: bool = False,
     checkpoint_path: str | None = None,
@@ -595,7 +664,6 @@ def check_device(
     f = _round_pow2(min(start_frontier, f_cap), 2)
     s = _round_pow2(max(len(enc.init_states), state_slots), 2)
     max_state_slots = 256
-    layers_done = 0
     frontier = None
 
     if checkpoint_path is not None:
@@ -603,6 +671,7 @@ def check_device(
 
         from .checkpoint import (
             Checkpoint,
+            CheckpointError,
             history_fingerprint,
             load_checkpoint,
             save_checkpoint,
@@ -612,7 +681,7 @@ def check_device(
         if os.path.exists(checkpoint_path):
             ck = load_checkpoint(checkpoint_path)
             if ck.fingerprint != fingerprint:
-                raise ValueError(
+                raise CheckpointError(
                     f"checkpoint {checkpoint_path} belongs to a different "
                     "history (fingerprint mismatch)"
                 )
@@ -621,15 +690,15 @@ def check_device(
                 # (its dead ends would be inconclusive forever), and vice
                 # versa a wider exhaustive frontier under beam rules skews
                 # stats; refuse rather than silently degrade.
-                raise ValueError(
+                raise CheckpointError(
                     f"checkpoint {checkpoint_path} was written by a "
                     f"{'beam' if ck.beam else 'exhaustive'} search and cannot "
                     f"resume a {'beam' if beam else 'exhaustive'} one"
                 )
             f = ck.f
-            layers_done = ck.layers_done
             for k, v in ck.stats.items():
                 setattr(stats, k, v)
+            stats.layers = ck.layers_done
             frontier = Frontier(
                 counts=jnp.asarray(ck.counts),
                 tail=jnp.asarray(ck.tail),
@@ -654,7 +723,7 @@ def check_device(
                     valid=np.asarray(fr.valid),
                     f=f,
                     beam=beam,
-                    layers_done=layers_done,
+                    layers_done=stats.layers,
                     stats=dataclasses.asdict(stats),
                 ),
             )
@@ -673,7 +742,7 @@ def check_device(
 
     while True:
         allow_prune = beam and f >= f_cap
-        layers_budget = cap_layers - layers_done
+        layers_budget = cap_layers - stats.layers
         if checkpoint_path is not None and checkpoint_every > 0:
             layers_budget = min(layers_budget, checkpoint_every)
         out = jax.device_get(
@@ -681,7 +750,6 @@ def check_device(
                 tables, frontier, np.int32(layers_budget), allow_prune=allow_prune
             )
         )
-        layers_done += int(out.layers)
         stats.layers += int(out.layers)
         stats.max_frontier = max(stats.max_frontier, int(out.max_live))
         stats.max_state_set = max(stats.max_state_set, int(out.max_state_set))
@@ -730,7 +798,7 @@ def check_device(
                 break
             frontier = _requeue(resume, snapshot=True)
             continue
-        if code == STOP_RUNNING and layers_done < cap_layers:
+        if code == STOP_RUNNING and stats.layers < cap_layers:
             # Chunk boundary (checkpoint cadence): snapshot and keep going
             # from the returned post-expansion frontier.
             nxt = Frontier(*(np.asarray(x) for x in out.frontier))
@@ -785,7 +853,7 @@ def check_device_auto(
     *,
     beam_width: int = 4096,
     exhaustive_cap: int = 16384,
-    state_slots: int = 8,
+    state_slots: int = 4,
     mesh=None,
     collect_stats: bool = False,
     checkpoint_path: str | None = None,
@@ -796,27 +864,53 @@ def check_device_auto(
 
     The beam and exhaustive phases use distinct checkpoint files (a beam
     snapshot must not resume an exhaustive pass, whose soundness rules
-    differ)."""
-    res = check_device(
-        history,
-        max_frontier=beam_width,
-        state_slots=state_slots,
-        beam=True,
-        mesh=mesh,
-        collect_stats=collect_stats,
-        checkpoint_path=(
-            f"{checkpoint_path}.beam" if checkpoint_path is not None else None
-        ),
-        checkpoint_every=checkpoint_every,
-    )
-    if res.outcome != CheckOutcome.UNKNOWN:
-        return res
+    differ); a conceded beam phase leaves a marker so a preempted
+    exhaustive phase does not replay the whole beam search on restart."""
+    marker = f"{checkpoint_path}.beam.conceded" if checkpoint_path else None
+    fingerprint = None
+    beam_already_conceded = False
     if checkpoint_path is not None:
-        # The conceded beam phase's snapshot must not linger: it would
-        # fingerprint-clash with the next history checked under this path.
-        with contextlib.suppress(FileNotFoundError):
-            os.remove(f"{checkpoint_path}.beam")
-    return check_device(
+        from .checkpoint import history_fingerprint
+
+        fingerprint = history_fingerprint(encode_history(history))
+        if os.path.exists(marker):
+            try:
+                with open(marker, encoding="utf-8") as fh:
+                    beam_already_conceded = fh.read().strip() == fingerprint
+            except OSError:
+                beam_already_conceded = False
+            if not beam_already_conceded:
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(marker)
+
+    if not beam_already_conceded:
+        res = check_device(
+            history,
+            max_frontier=beam_width,
+            state_slots=state_slots,
+            beam=True,
+            mesh=mesh,
+            collect_stats=collect_stats,
+            checkpoint_path=(
+                f"{checkpoint_path}.beam" if checkpoint_path is not None else None
+            ),
+            checkpoint_every=checkpoint_every,
+        )
+        if res.outcome != CheckOutcome.UNKNOWN:
+            if marker is not None:
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(marker)
+            return res
+        if checkpoint_path is not None:
+            # The conceded beam phase's snapshot must not linger (it would
+            # fingerprint-clash with the next history under this path), and
+            # the marker spares a preempted exhaustive phase from replaying
+            # the whole beam search on restart.
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(f"{checkpoint_path}.beam")
+            with open(marker, "w", encoding="utf-8") as fh:
+                fh.write(fingerprint)
+    res = check_device(
         history,
         max_frontier=exhaustive_cap,
         state_slots=state_slots,
@@ -826,3 +920,10 @@ def check_device_auto(
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every,
     )
+    # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
+    # paired with the kept exhaustive snapshot: a retry (e.g. with a larger
+    # cap) skips straight past the already-conceded beam phase.
+    if marker is not None and res.outcome != CheckOutcome.UNKNOWN:
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(marker)
+    return res

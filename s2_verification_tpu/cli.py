@@ -141,10 +141,16 @@ def _cmd_check(args: argparse.Namespace) -> int:
             args.backend, checked, args.time_budget, checkpoint=args.checkpoint
         )
     except Exception as e:  # backend/environment failure, not a verdict
+        from .checker.checkpoint import CheckpointError
         from .checker.native import NativeUnavailable
 
         if isinstance(e, NativeUnavailable):
             log.error("native backend unavailable: %s", e)
+            return USAGE_EXIT
+        if isinstance(e, CheckpointError):
+            log.error(
+                "%s — remove the file or point -checkpoint elsewhere", e
+            )
             return USAGE_EXIT
         raise
     dt = time.monotonic() - t0

@@ -127,6 +127,47 @@ def test_beam_snapshot_cannot_resume_exhaustive(hist, tmp_path):
         check_device(hist, beam=False, checkpoint_path=ck)
 
 
+def test_corrupt_snapshot_raises_checkpoint_error(hist, tmp_path):
+    from s2_verification_tpu.checker.checkpoint import CheckpointError
+
+    ck = tmp_path / "search.ckpt"
+    ck.write_bytes(b"not a zip archive")
+    with pytest.raises(CheckpointError):
+        load_checkpoint(str(ck))
+    with pytest.raises(CheckpointError):
+        check_device(hist, beam=False, checkpoint_path=str(ck))
+
+
+def test_cli_stale_checkpoint_exits_64(hist, tmp_path):
+    from s2_verification_tpu.cli import main
+    from s2_verification_tpu.utils import events as ev
+
+    hist_a = tmp_path / "a.jsonl"
+    with open(hist_a, "w") as fh:
+        ev.write_history(
+            collect_history(
+                CollectConfig(num_concurrent_clients=2, num_ops_per_client=5, seed=1)
+            ),
+            fh,
+        )
+    ck = tmp_path / "run.ckpt"
+    # The auto driver's beam phase loads <path>.beam first.
+    (tmp_path / "run.ckpt.beam").write_bytes(b"garbage")
+    rc = main(
+        [
+            "check",
+            "-file",
+            str(hist_a),
+            "-backend",
+            "device",
+            "-checkpoint",
+            str(ck),
+            "-no-viz",
+        ]
+    )
+    assert rc == 64
+
+
 def test_mismatched_history_rejected(hist, tmp_path):
     ck = str(tmp_path / "search.ckpt")
     enc = encode_history(hist)
