@@ -1,0 +1,26 @@
+# Build/test entry points, mirroring the reference's Makefile
+# (reference Makefile:1-24: build-go/test-go/install-go/clean-go).
+
+PYTHON ?= python
+
+.PHONY: all native test test-fast bench clean
+
+all: native
+
+# The native C++ checker (the reference's compiled-Go/porcupine analog).
+native:
+	$(MAKE) -C native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+# Skip the slow device differential sweeps.
+test-fast: native
+	$(PYTHON) -m pytest tests/ -q -k "not device and not dryrun"
+
+bench:
+	$(PYTHON) bench.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .pytest_cache s2_verification_tpu/__pycache__
