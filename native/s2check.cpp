@@ -364,6 +364,10 @@ int32_t s2_check(
           calls.push_back(Undo{entry, std::move(states)});
           states = std::move(ns);
           lift(entry);
+          if (calls.size() > best_count) {
+            best_count = calls.size();
+            best_bits = bits;
+          }
           entry = head;
           continue;
         }
@@ -399,7 +403,9 @@ int32_t s2_check(
     out_states_hash[i] = states[i].hash;
     out_states_tok[i] = states[i].tok;
   }
-  *out_states_len = m;
+  // Report the FULL size (not the clamped write count) so the caller can
+  // detect truncation and re-invoke with a larger buffer.
+  *out_states_len = static_cast<int32_t>(states.size());
   *out_steps = steps;
   *out_cache_hits = cache_hits;
   return 0;

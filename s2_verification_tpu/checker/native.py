@@ -21,7 +21,7 @@ import threading
 
 import numpy as np
 
-from ..models.encode import INF_TIME, encode_history, intern_state
+from ..models.encode import encode_history, intern_state
 from ..models.stream import StreamState
 from .entries import History
 from .oracle import CheckOutcome, CheckResult
@@ -101,12 +101,16 @@ def _ptr(a: np.ndarray, typ):
 
 
 def check_native(
-    history: History, time_budget_s: float | None = None
+    history: History,
+    time_budget_s: float | None = None,
+    _states_cap: int = 4096,
 ) -> CheckResult:
     """Decide linearizability with the native engine.
 
-    Verdict semantics match :func:`..checker.oracle.check`; ``deepest`` is
-    not reported (use the Python oracle for failure diagnostics).
+    Verdict semantics match :func:`..checker.oracle.check`, including the
+    ``deepest`` linearized set on ILLEGAL/UNKNOWN.  ``_states_cap`` sizes
+    the final-state output buffer (test hook; the wrapper retries with the
+    exact size on overflow, so the default only affects allocation).
     """
     lib = _load()
     enc = encode_history(history)
@@ -130,7 +134,7 @@ def check_native(
     )
     order = np.zeros(max(1, n), np.int32)
     order_len = ct.c_int32(0)
-    states_cap = 4096
+    states_cap = _states_cap
     st_tail = np.zeros(states_cap, np.uint32)
     st_hash = np.zeros(states_cap, np.uint64)
     st_tok = np.zeros(states_cap, np.int32)
@@ -140,7 +144,7 @@ def check_native(
 
     i32, u32, u64, u8 = ct.c_int32, ct.c_uint32, ct.c_uint64, ct.c_uint8
 
-    def invoke():
+    def invoke(budget_s):
         return lib.s2_check(
         ct.c_int32(n),
         _ptr(np.ascontiguousarray(enc.op_type, np.int32), i32),
@@ -167,7 +171,7 @@ def check_native(
         _ptr(init_tail, u32),
         _ptr(init_hash, u64),
         _ptr(init_tok, i32),
-        ct.c_double(-1.0 if time_budget_s is None else time_budget_s),
+        ct.c_double(budget_s),
         _ptr(order, i32),
         ct.byref(order_len),
         _ptr(st_tail, u32),
@@ -179,16 +183,19 @@ def check_native(
             ct.byref(hits),
         )
 
-    rc = invoke()
+    rc = invoke(-1.0 if time_budget_s is None else time_budget_s)
     if rc == 0 and states_len.value > states_cap:
         # Final state set overflowed the buffer; re-run with room for all of
-        # it (rare: needs >4096 simultaneously-open ambiguous appends).
+        # it (rare: needs >4096 simultaneously-open ambiguous appends).  The
+        # retry runs unbudgeted: OK is already proven and the re-derivation
+        # is deterministic, so a timeout here must not downgrade the verdict
+        # (wall-clock can reach ~2x the budget in this rare case).
         states_cap = int(states_len.value)
         st_tail = np.zeros(states_cap, np.uint32)
         st_hash = np.zeros(states_cap, np.uint64)
         st_tok = np.zeros(states_cap, np.int32)
-        rc = invoke()
-        assert states_len.value <= states_cap
+        rc = invoke(-1.0)
+        assert rc == 0 and states_len.value <= states_cap
 
     # Encoded op index → History.ops index (forced-prefix ops were peeled
     # off before encoding).

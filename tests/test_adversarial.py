@@ -1,0 +1,82 @@
+"""Differential tests for the adversarial history family.
+
+``collector/adversarial.py`` generates the search-hardness regime the
+north star names (histories whose ambiguity is global: k overlapping
+ambiguous appends + one pinning read — reference README.md:74 "the more
+clients, the harder").  These tests pin the generator against every
+engine at small k, including the ILLEGAL-by-exhaustion path.
+"""
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier
+from s2_verification_tpu.checker.device import check_device
+from s2_verification_tpu.checker.native import check_native, native_available
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.adversarial import (
+    adversarial_events,
+    ordered_subsets_count,
+)
+
+
+def test_ordered_subsets_count():
+    # sum_{m=0..k} k!/(k-m)!
+    assert ordered_subsets_count(0) == 1
+    assert ordered_subsets_count(1) == 2
+    assert ordered_subsets_count(2) == 5  # {}, a, b, ab, ba
+    assert ordered_subsets_count(3) == 16
+    assert ordered_subsets_count(8) == 109601
+
+
+@pytest.mark.parametrize("k,applied", [(2, 1), (3, 0), (3, 2), (4, 2), (4, 4)])
+def test_satisfiable_is_ok_on_all_engines(k, applied):
+    hist = prepare(adversarial_events(k, batch=3, applied=applied, seed=k))
+    want = check(hist)
+    assert want.outcome == CheckOutcome.OK
+    assert check_frontier(hist).outcome == CheckOutcome.OK
+    dev = check_device(hist, beam=False, start_frontier=16, max_frontier=1024)
+    assert dev.outcome == CheckOutcome.OK
+    if native_available():
+        assert check_native(hist).outcome == CheckOutcome.OK
+
+
+@pytest.mark.parametrize("k", [2, 3, 4])
+def test_unsatisfiable_is_illegal_by_exhaustion(k):
+    # The corrupted pin admits no ordered subset; every engine must exhaust
+    # the full space (no shortcut exists) and conclude ILLEGAL.
+    hist = prepare(adversarial_events(k, batch=3, seed=7, unsatisfiable=True))
+    want = check(hist)
+    assert want.outcome == CheckOutcome.ILLEGAL
+    # Exhaustion really visited the space: at least one state per ordered
+    # subset of the k appends was stepped.
+    assert want.steps >= ordered_subsets_count(k)
+    assert check_frontier(hist).outcome == CheckOutcome.ILLEGAL
+    dev = check_device(hist, beam=False, start_frontier=16, max_frontier=1024)
+    assert dev.outcome == CheckOutcome.ILLEGAL
+    if native_available():
+        assert check_native(hist).outcome == CheckOutcome.ILLEGAL
+
+
+def test_adversarial_beam_ok_is_conclusive():
+    # Beam mode may prune, but an OK it does report is sound.
+    hist = prepare(adversarial_events(5, batch=4, seed=1))
+    res = check_device(hist, beam=True, start_frontier=16, max_frontier=512)
+    assert res.outcome in (CheckOutcome.OK, CheckOutcome.UNKNOWN)
+    if res.outcome == CheckOutcome.OK:
+        assert check(hist).outcome == CheckOutcome.OK
+
+
+def test_applied_bounds_validated():
+    with pytest.raises(ValueError):
+        adversarial_events(3, applied=4)
+    with pytest.raises(ValueError):
+        adversarial_events(3, applied=-1)
+
+
+def test_seed_reproducibility():
+    a = adversarial_events(4, batch=5, seed=9)
+    b = adversarial_events(4, batch=5, seed=9)
+    assert a == b
+    c = adversarial_events(4, batch=5, seed=10)
+    assert a != c

@@ -121,6 +121,39 @@ def test_mixed_token_states_sort():
     assert sorted(rn.final_states) == sorted(ro.final_states)
 
 
+def test_native_states_cap_retry():
+    # Three independent indefinite appends with distinct hashes → 2^3 = 8
+    # candidate final states.  A tiny output buffer must trigger the
+    # truncation retry: the C side reports the FULL set size (not the
+    # clamped write count), the wrapper reallocates and re-invokes.
+    h = H()
+    for i in range(3):
+        h.append_indefinite_fail(i + 1, [100 + i])
+    hist = prepare(h.events)
+    full = check_native(hist)
+    small = check_native(hist, _states_cap=1)
+    assert full.ok and small.ok
+    assert len(full.final_states) == 8
+    assert sorted(small.final_states) == sorted(full.final_states)
+
+
+def test_native_deepest_on_concurrent_illegal():
+    # Two overlapping appends that both claim tail=1: exactly one can ever
+    # be linearized, so deepest must contain one op (not be empty — the
+    # engine tracks the best set reached during the search, oracle.py:173).
+    from s2_verification_tpu.utils.events import AppendSuccess
+
+    h = H()
+    a = h.call_append(1, [11])
+    b = h.call_append(2, [22])
+    h.finish(1, a, AppendSuccess(tail=1))
+    h.finish(2, b, AppendSuccess(tail=1))
+    hist = prepare(h.events)
+    rn, ro = check_native(hist), check(hist)
+    assert rn.outcome == ro.outcome == CheckOutcome.ILLEGAL
+    assert rn.deepest and sorted(rn.deepest) == sorted(ro.deepest)
+
+
 def test_native_stats_populated():
     events = collect_history(
         CollectConfig(num_concurrent_clients=2, num_ops_per_client=10, seed=1)
