@@ -33,7 +33,7 @@ __all__ = [
     "CheckpointError",
 ]
 
-_FORMAT = 1
+_FORMAT = 2  # v2: per-state frontier rows (no svalid / state-slot axis)
 
 
 class CheckpointError(ValueError):
@@ -88,7 +88,6 @@ class Checkpoint:
     hi: np.ndarray
     lo: np.ndarray
     tok: np.ndarray
-    svalid: np.ndarray
     valid: np.ndarray
     #: driver state
     f: int
@@ -117,7 +116,6 @@ def save_checkpoint(path: str, ckpt: Checkpoint) -> None:
                 hi=ckpt.hi,
                 lo=ckpt.lo,
                 tok=ckpt.tok,
-                svalid=ckpt.svalid,
                 valid=ckpt.valid,
             )
         os.replace(tmp, path)
@@ -143,7 +141,6 @@ def load_checkpoint(path: str) -> Checkpoint:
                 hi=z["hi"],
                 lo=z["lo"],
                 tok=z["tok"],
-                svalid=z["svalid"],
                 valid=z["valid"],
                 f=int(meta["f"]),
                 beam=bool(meta["beam"]),

@@ -5,51 +5,59 @@ The jit/vmap twin of :mod:`.frontier`: the whole layer-by-layer search runs
 frontier of configurations, so there is no per-layer host dispatch.  The
 host's only jobs are encoding the history (models/encode.py), picking
 capacity buckets, and escalating when a run reports it needs a wider
-frontier or state set.
+frontier.
 
-A configuration is ``(counts per chain, canonical candidate-state set)``:
+A configuration row is ``(counts per chain, ONE candidate state)``:
 
-- ``counts  [F, C] int32``  — linearized prefix length of every chain;
-- ``tail/hash_hi/hash_lo/token  [F, S]`` + ``svalid [F, S] bool`` — the
-  state set, canonically sorted (valid first, then by state key) and
-  zeroed in invalid slots so equal sets are bitwise equal;
-- ``valid [F] bool`` — frontier occupancy.
+- ``counts  [F, C] int32`` — linearized prefix length of every chain;
+- ``tail/hi/lo/tok  [F]`` — one model state;
+- ``valid [F] bool`` — row occupancy.
+
+This is the per-state flattening of the powerset-lifted search the host
+engines run: a configuration's candidate-state *set* is non-empty iff at
+least one member survives, and members step independently (``step_set`` is
+a union of per-member steps), so tracking ``(counts, member)`` rows and
+deduping them yields identical OK/ILLEGAL verdicts while keeping every
+vector lane a real state — no per-row set dimension, no padding, no
+per-child set canonicalization.  (Reference semantics:
+``porcupine.CheckEventsVerbose(model, events, 0)`` as driven by
+golang/s2-porcupine/main.go:605-606; step truth table main.go:264-335.)
 
 One layer (the while-loop body):
 
-1. **auto-close** — a nested, vmapped ``lax.while_loop`` advances each
-   configuration past indefinite appends whose effect branch is provably
-   dead (guards stale against every candidate state, token never settable)
-   — the device twin of frontier.py's auto-close;
-2. **accept** — a configuration whose remaining ops are all indefinite
-   appends accepts the history (table lookup + reduction);
-3. **expand** — every (configuration × candidate chain × candidate state)
-   triple steps through :func:`~..ops.step_kernel.step_kernel` under two
-   nested ``vmap``s; successor sets are deduped and canonicalized with an
-   O(S²) comparison matrix + ``lexsort`` per child;
-4. **dedup + compact** — children flatten to ``[F*C]`` rows, get a 64-bit
-   mixed hash, and a global ``lexsort`` by (validity, lazy-order rank,
-   hash) brings equal configurations adjacent for exact-compare dedup; a
-   second stable sort compacts survivors into the next frontier.  Layers
-   never revisit earlier configurations (sum(counts) grows by one per
-   layer) so no cross-layer visited set is needed.
+1. **auto-close** — a vmapped nested ``lax.while_loop`` advances each row
+   past indefinite appends whose effect branch is provably dead (stale
+   ``match_seq_num`` guard under monotone tails, or a fencing token no
+   remaining op can set);
+2. **accept** — a row whose remaining ops are all indefinite appends
+   accepts the history (table lookup + reduction);
+3. **expand** — every (row × candidate chain) steps through
+   :func:`~..ops.step_kernel.step_kernel` under nested ``vmap``; an
+   indefinite append emits two child rows (effect / no-effect), everything
+   else one;
+4. **dedup + compact** — children get a 64-bit (2×u32) mixed hash of
+   (Zobrist counts hash, state) and dedup through a scatter-min hash
+   table with exact compare against each slot winner — O(children) work,
+   no global sort.  Unresolved hash collisions are *kept* (a missed merge
+   only costs capacity, never soundness).  Survivors compact into the next
+   frontier with a cumsum scatter; beam pruning selects the lazy-best
+   (fewest linearized indefinite appends) via a bincount threshold, also
+   sort-free.
+
+Layers never revisit earlier configurations (sum(counts) grows by one per
+layer) so no cross-layer visited set is needed.
 
 Soundness under capacity pressure mirrors the host beam search: an OK is
-always conclusive (every frontier state is genuinely reachable); a dead end
-after any pruning or state-set overflow is UNKNOWN, and the driver
-escalates to the next capacity bucket, resuming from the last intact
-pre-expansion frontier that the compiled program hands back.
+always conclusive (every frontier row is genuinely reachable); a dead end
+after any pruning is UNKNOWN, and the driver escalates to the next
+capacity bucket, resuming from the last intact pre-expansion frontier that
+the compiled program hands back.
 
-Multi-chip: every per-configuration computation is elementwise over the
-frontier axis, so sharding ``F`` over a :class:`jax.sharding.Mesh` makes
-expansion embarrassingly parallel; the dedup sorts become XLA global sorts
-with ICI collectives.  :func:`place_frontier` applies the sharding; the
-driver accepts a ``mesh=`` argument.
-
-Reference parity: the verdict semantics match
-``porcupine.CheckEventsVerbose(model, events, 0)`` as used by
-golang/s2-porcupine/main.go:605-606; the step truth table is
-main.go:264-335 (see ops/step_kernel.py).
+Multi-chip: every per-row computation is elementwise over the frontier
+axis, so sharding ``F`` over a :class:`jax.sharding.Mesh` makes expansion
+embarrassingly parallel; the dedup table scatter/gather become XLA
+collective ops.  :func:`place_frontier` applies the sharding; the driver
+accepts a ``mesh=`` argument.
 """
 
 from __future__ import annotations
@@ -66,6 +74,7 @@ from jax import lax
 
 from ..models.encode import INF_TIME, EncodedHistory, encode_history, intern_state
 from ..models.stream import StreamState
+from ..utils.cache import enable_persistent_cache
 from .entries import History
 from .frontier import FrontierStats
 from .oracle import CheckOutcome, CheckResult
@@ -82,14 +91,22 @@ __all__ = [
     "place_frontier",
 ]
 
+enable_persistent_cache()
+
 _I32 = jnp.int32
 _U32 = jnp.uint32
+
+#: beam-priority classes (linearized-indefinite-append counts) are clamped
+#: here; ties above the clamp only coarsen pruning priority, never verdicts.
+_OPENS_CAP = 256
 
 
 class SearchTables(NamedTuple):
     """Device-resident static tables for one encoded history."""
 
     ops: DeviceOps
+    #: per-op: indefinite append (two-branch step)
+    is_indef: jnp.ndarray  # [N] bool
     #: per-op: indefinite append with a match_seq_num guard (auto-close arm 1)
     ac_match: jnp.ndarray  # [N] bool
     #: per-op: indefinite append whose batch token is never set by any op
@@ -98,24 +115,23 @@ class SearchTables(NamedTuple):
     accept_tab: jnp.ndarray  # [C, Lc+1] bool
     #: opens_tab[c, k]: # indefinite appends among the first k ops of chain c
     opens_tab: jnp.ndarray  # [C, Lc+1] int32
+    #: Zobrist tables for incremental counts hashing: zob*[c, k] is the
+    #: contribution of "chain c has linearized k ops"
+    zob1: jnp.ndarray  # [C, Lc+2] uint32
+    zob2: jnp.ndarray  # [C, Lc+2] uint32
 
 
 class Frontier(NamedTuple):
     counts: jnp.ndarray  # [F, C] int32
-    tail: jnp.ndarray  # [F, S] uint32
-    hi: jnp.ndarray  # [F, S] uint32
-    lo: jnp.ndarray  # [F, S] uint32
-    tok: jnp.ndarray  # [F, S] int32
-    svalid: jnp.ndarray  # [F, S] bool
+    tail: jnp.ndarray  # [F] uint32
+    hi: jnp.ndarray  # [F] uint32
+    lo: jnp.ndarray  # [F] uint32
+    tok: jnp.ndarray  # [F] int32
     valid: jnp.ndarray  # [F] bool
 
     @property
     def capacity(self) -> int:
         return int(self.valid.shape[0])
-
-    @property
-    def state_slots(self) -> int:
-        return int(self.tail.shape[1])
 
 
 class RunOut(NamedTuple):
@@ -131,6 +147,8 @@ class RunOut(NamedTuple):
     max_state_set: jnp.ndarray
     auto_closed: jnp.ndarray
     expanded: jnp.ndarray
+    #: counts of one live row of the deepest committed layer (diagnostics)
+    deep_counts: jnp.ndarray  # [C] int32
 
 
 STOP_RUNNING, STOP_ACCEPT, STOP_EMPTY, STOP_CAPACITY = 0, 1, 2, 3
@@ -167,44 +185,48 @@ def build_tables(enc: EncodedHistory) -> SearchTables:
             accept_tab[ci, k] = accept_tab[ci, k + 1] and bool(
                 is_indef[enc.chain_ops[ci, k]]
             )
+    rng = np.random.Generator(np.random.PCG64(0x52C0FFEE))
+    zob = rng.integers(0, 1 << 32, size=(2, c, lc + 2), dtype=np.uint32)
     return SearchTables(
         ops=DeviceOps.from_encoded(enc),
+        is_indef=jnp.asarray(is_indef),
         ac_match=jnp.asarray(ac_match),
         ac_tok=jnp.asarray(ac_tok),
         accept_tab=jnp.asarray(accept_tab),
         opens_tab=jnp.asarray(opens_tab),
+        zob1=jnp.asarray(zob[0]),
+        zob2=jnp.asarray(zob[1]),
     )
 
 
 def init_frontier(
-    enc: EncodedHistory, capacity: int, state_slots: int
+    enc: EncodedHistory, capacity: int, state_slots: int | None = None
 ) -> Frontier:
+    """One row per initial state.  ``state_slots`` is accepted for driver
+    compatibility and ignored (rows are single states)."""
+    del state_slots
     c = enc.num_chains
-    states = [intern_state(enc, s) for s in enc.init_states]
-    states.sort()
-    if len(states) > state_slots:
+    states = sorted(intern_state(enc, s) for s in enc.init_states)
+    if len(states) > capacity:
         raise ValueError(
-            f"{len(states)} initial states exceed {state_slots} state slots"
+            f"{len(states)} initial states exceed frontier capacity {capacity}"
         )
     counts = np.zeros((capacity, c), np.int32)
     counts[:] = enc.chain_start[None, :]
-    tail = np.zeros((capacity, state_slots), np.uint32)
-    hi = np.zeros((capacity, state_slots), np.uint32)
-    lo = np.zeros((capacity, state_slots), np.uint32)
-    tok = np.zeros((capacity, state_slots), np.int32)
-    svalid = np.zeros((capacity, state_slots), bool)
-    for i, (t, h, l, k) in enumerate(states):
-        tail[0, i], hi[0, i], lo[0, i], tok[0, i] = t, h, l, k
-        svalid[0, i] = True
+    tail = np.zeros(capacity, np.uint32)
+    hi = np.zeros(capacity, np.uint32)
+    lo = np.zeros(capacity, np.uint32)
+    tok = np.zeros(capacity, np.int32)
     valid = np.zeros(capacity, bool)
-    valid[0] = True
+    for i, (t, h, l, k) in enumerate(states):
+        tail[i], hi[i], lo[i], tok[i] = t, h, l, k
+        valid[i] = True
     return Frontier(
         counts=jnp.asarray(counts),
         tail=jnp.asarray(tail),
         hi=jnp.asarray(hi),
         lo=jnp.asarray(lo),
         tok=jnp.asarray(tok),
-        svalid=jnp.asarray(svalid),
         valid=jnp.asarray(valid),
     )
 
@@ -221,12 +243,12 @@ def place_frontier(frontier: Frontier, mesh, axis: str = "fr") -> Frontier:
 
 
 # ---------------------------------------------------------------------------
-# Per-configuration pieces (to be vmapped over the frontier axis)
+# Per-row pieces (to be vmapped over the frontier axis)
 # ---------------------------------------------------------------------------
 
 
 def _next_and_cands(tables: SearchTables, counts):
-    """Next-op index per chain and the candidate mask, for one config."""
+    """Next-op index per chain and the candidate mask, for one row."""
     ops = tables.ops
     has_next = counts < ops.chain_len
     idx = jnp.minimum(counts, jnp.maximum(ops.chain_len - 1, 0))
@@ -238,21 +260,23 @@ def _next_and_cands(tables: SearchTables, counts):
     return nxt, cand
 
 
-def _dead_mask(tables: SearchTables, nxt, cand, st_tail, st_tok, svalid):
-    """Candidates whose indefinite-append effect branch is dead forever."""
-    ops = tables.ops
-    ms = ops.match_seq[nxt]  # [C] u32
-    all_gt = ((~svalid)[None, :] | (st_tail[None, :] > ms[:, None])).all(axis=1)
-    bt = ops.batch_token[nxt]
-    none_match = ((~svalid)[None, :] | (st_tok[None, :] != bt[:, None])).all(axis=1)
-    dead = (tables.ac_match[nxt] & all_gt) | (tables.ac_tok[nxt] & none_match)
-    return cand & dead
+def _auto_close_row(tables: SearchTables, counts, tail, tok, cfg_valid):
+    """Advance one row past indefinite appends whose effect branch is dead.
 
+    Tails are monotone along every path, so a stale ``match_seq_num`` can
+    never match again; a fencing token no remaining op sets can never come
+    to match either.  Linearizing such an op immediately (no-effect branch)
+    is sound and complete — see frontier.py's auto-close notes.
+    """
 
-def _auto_close_one(tables: SearchTables, counts, st_tail, st_tok, svalid, cfg_valid):
     def dead_now(c):
         nxt, cand = _next_and_cands(tables, c)
-        return _dead_mask(tables, nxt, cand, st_tail, st_tok, svalid)
+        ms = tables.ops.match_seq[nxt]
+        bt = tables.ops.batch_token[nxt]
+        dead = (tables.ac_match[nxt] & (tail > ms)) | (
+            tables.ac_tok[nxt] & (tok != bt)
+        )
+        return cand & dead
 
     def cond(c):
         return cfg_valid & dead_now(c).any()
@@ -264,124 +288,43 @@ def _auto_close_one(tables: SearchTables, counts, st_tail, st_tok, svalid, cfg_v
     return closed, (closed - counts).sum()
 
 
-def _canon_states(t, h, l, k, v, s):
-    """Dedup + canonically sort one candidate state set into ``s`` slots.
-
-    Inputs are flat arrays of 2S successor states (+ validity); returns the
-    sorted, zero-padded set plus an overflow flag (more than ``s`` distinct
-    valid states)."""
-    n2 = t.shape[0]
-    eqm = (
-        (t[:, None] == t[None, :])
-        & (h[:, None] == h[None, :])
-        & (l[:, None] == l[None, :])
-        & (k[:, None] == k[None, :])
-    )
-    lower = jnp.tril(jnp.ones((n2, n2), bool), -1)  # [i, j] = j < i
-    dup = (eqm & lower & v[None, :]).any(axis=1)
-    keep = v & ~dup
-    order = jnp.lexsort((k.astype(_U32), l, h, t, (~keep).astype(_I32)))
-    keep_s = keep[order][:s]
-    z = lambda x: jnp.where(keep_s, x[order][:s], 0)
-    return (
-        z(t),
-        z(h),
-        z(l),
-        jnp.where(keep_s, k[order][:s].astype(_I32), 0),
-        keep_s,
-        keep.sum() > s,
-    )
-
-
-def _step_states(tables: SearchTables, o, st_tail, st_hi, st_lo, st_tok, svalid):
-    """Apply op ``o`` to a candidate state set; returns the flat 2S successor
-    candidates (optimistic + no-effect branches) with validity."""
-
-    def per_state(t, h, l, k):
-        return step_kernel(tables.ops, o, DeviceState(t, h, l, k))
-
-    a, va, b, vb = jax.vmap(per_state)(st_tail, st_hi, st_lo, st_tok)
-    t2 = jnp.concatenate([a.tail, b.tail])
-    h2 = jnp.concatenate([a.hash_hi, b.hash_hi])
-    l2 = jnp.concatenate([a.hash_lo, b.hash_lo])
-    k2 = jnp.concatenate([a.token, b.token])
-    v2 = jnp.concatenate([va & svalid, vb & svalid])
-    return t2, h2, l2, k2, v2
-
-
-def _expand_one(tables: SearchTables, counts, st_tail, st_hi, st_lo, st_tok, svalid, cfg_valid):
-    """All children of one configuration: one per candidate chain.
-
-    Returns per-chain arrays: child counts [C, C], canonical child state
-    sets [C, S]×4 (+ svalid), child validity [C], per-chain overflow [C].
-    """
-    c = counts.shape[0]
-    s = st_tail.shape[0]
-    nxt, cand = _next_and_cands(tables, counts)
-
-    t2, h2, l2, k2, v2 = jax.vmap(
-        lambda o: _step_states(tables, o, st_tail, st_hi, st_lo, st_tok, svalid)
-    )(nxt)  # [C, 2S] each
-
-    ct, ch, cl, ck, cv, over = jax.vmap(partial(_canon_states, s=s))(
-        t2, h2, l2, k2, v2
-    )
-    child_counts = counts[None, :] + jnp.eye(c, dtype=_I32)
-    child_valid = cfg_valid & cand & cv.any(axis=1)
-    overflow = (child_valid & over).any()
-    return child_counts, ct, ch, cl, ck, cv, child_valid, overflow, cand.sum()
-
-
 def _accept_one(tables: SearchTables, counts, cfg_valid):
     c = counts.shape[0]
     return cfg_valid & tables.accept_tab[jnp.arange(c), counts].all()
 
 
 def _fast_layer(tables: SearchTables, frontier: Frontier):
-    """One forced step on the unique live configuration.
+    """One forced step on the unique live row.
 
-    Precondition (checked by the caller): exactly one configuration is live
-    and its candidate window holds exactly one chain.  The single child
-    needs no cross-configuration dedup or compaction, so the layer skips
-    the frontier-wide lexsorts — the dominant cost on the long sequential
-    stretches of collector histories.  Return signature matches
-    :func:`_expand_layer`.
+    Precondition (checked by the caller): exactly one row is live, its
+    candidate window holds exactly one chain, and the op is not an
+    indefinite append (single successor).  The child needs no dedup or
+    compaction, so the layer skips the frontier-wide hash table — the
+    dominant cost on the long sequential stretches of collector histories.
+    Return signature matches :func:`_expand_layer`.
     """
-    s = frontier.state_slots
     idx = jnp.argmax(frontier.valid)
     counts = frontier.counts[idx]
     nxt, cand = _next_and_cands(tables, counts)
     chain = jnp.argmax(cand)
     o = nxt[chain]
-    t2, h2, l2, k2, v2 = _step_states(
-        tables,
-        o,
-        frontier.tail[idx],
-        frontier.hi[idx],
-        frontier.lo[idx],
-        frontier.tok[idx],
-        frontier.svalid[idx],
-    )
-    ct, ch, cl, ck, cv, over = _canon_states(t2, h2, l2, k2, v2, s)
-    child_valid = cv.any()
+    st = DeviceState(frontier.tail[idx], frontier.hi[idx], frontier.lo[idx], frontier.tok[idx])
+    sa, va, _sb, _vb = step_kernel(tables.ops, o, st)
     children = Frontier(
         counts=frontier.counts.at[idx, chain].add(1),
-        tail=frontier.tail.at[idx].set(ct),
-        hi=frontier.hi.at[idx].set(ch),
-        lo=frontier.lo.at[idx].set(cl),
-        tok=frontier.tok.at[idx].set(ck),
-        svalid=frontier.svalid.at[idx].set(cv),
-        valid=frontier.valid.at[idx].set(child_valid),
+        tail=frontier.tail.at[idx].set(sa.tail),
+        hi=frontier.hi.at[idx].set(sa.hash_hi),
+        lo=frontier.lo.at[idx].set(sa.hash_lo),
+        tok=frontier.tok.at[idx].set(sa.token),
+        valid=frontier.valid.at[idx].set(va),
     )
-    n_unique = child_valid.astype(_I32)
-    mss = cv.sum().astype(_I32)
     return (
         children,
         jnp.zeros((), bool),
-        over & child_valid,
-        n_unique,
+        jnp.zeros((), bool),
+        va.astype(_I32),
         jnp.ones((), _I32),
-        mss,
+        jnp.ones((), _I32),
     )
 
 
@@ -402,92 +345,155 @@ def _mix_hash(cols, n, seed):
     return h ^ (h >> 16)
 
 
-def _expand_layer(tables: SearchTables, frontier: Frontier):
+def _zob_fold(zob, counts):
+    """XOR-fold a Zobrist table over a counts matrix: [F, C] → [F] u32."""
+    f, c = counts.shape
+    contrib = zob[jnp.arange(c)[None, :], counts]  # [F, C]
+    return lax.reduce(contrib, _U32(0), lax.bitwise_xor, dimensions=(1,))
+
+
+def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool):
     """Expand + dedup + compact one layer.  Returns (children, pruned,
     overflow, n_unique, expanded, max_state_set)."""
     f, c = frontier.counts.shape
-    s = frontier.state_slots
+    ops = tables.ops
 
-    (ccounts, ct, ch, cl, ck, cv, cvalid, over, ncand) = jax.vmap(
-        partial(_expand_one, tables)
-    )(
-        frontier.counts,
-        frontier.tail,
-        frontier.hi,
-        frontier.lo,
-        frontier.tok,
-        frontier.svalid,
-        frontier.valid,
-    )
+    nxt, cand = jax.vmap(partial(_next_and_cands, tables))(frontier.counts)
+    cand = cand & frontier.valid[:, None]  # [F, C]
+
+    def row_step(t, h, l, k, nxt_row):
+        def per_chain(o):
+            sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
+            return sa, va, vb
+
+        return jax.vmap(per_chain)(nxt_row)
+
+    sa, va, vb = jax.vmap(row_step)(
+        frontier.tail, frontier.hi, frontier.lo, frontier.tok, nxt
+    )  # [F, C] each; the no-effect fork's state is the parent state itself
+    va = va & cand
+    vb = vb & cand
+
     e = f * c
-    flat = lambda x: x.reshape((e,) + x.shape[2:])
-    ccounts, ct, ch, cl, ck, cv = map(flat, (ccounts, ct, ch, cl, ck, cv))
-    cvalid = cvalid.reshape(e)
-    overflow = over.any()
-    expanded = jnp.where(frontier.valid, ncand, 0).sum()
+    e2 = 2 * e
+    parent = jnp.repeat(jnp.arange(f, dtype=_I32), c)  # [e]
+    chain = jnp.tile(jnp.arange(c, dtype=_I32), f)  # [e]
+    fl = lambda x: x.reshape(e)
 
-    # Lazy-order rank: total indefinite appends linearized (fewest first).
-    # Invalid children can carry counts one past a finished chain; clamp.
-    idx = jnp.minimum(ccounts.T, tables.opens_tab.shape[1] - 1)
-    opens = jnp.take_along_axis(tables.opens_tab, idx, axis=1).sum(axis=0)
+    parent2 = jnp.concatenate([parent, parent])
+    chain2 = jnp.concatenate([chain, chain])
+    t2 = jnp.concatenate([fl(sa.tail), frontier.tail[parent]])
+    h2 = jnp.concatenate([fl(sa.hash_hi), frontier.hi[parent]])
+    l2 = jnp.concatenate([fl(sa.hash_lo), frontier.lo[parent]])
+    k2 = jnp.concatenate([fl(sa.token), frontier.tok[parent]])
+    valid2 = jnp.concatenate([fl(va), fl(vb)])
 
-    cols = (
-        [ccounts[:, i] for i in range(c)]
-        + [ct[:, i] for i in range(s)]
-        + [ch[:, i] for i in range(s)]
-        + [cl[:, i] for i in range(s)]
-        + [ck[:, i] for i in range(s)]
-        + [cv[:, i] for i in range(s)]
-    )
-    h1 = _mix_hash(cols, e, 0x811C9DC5)
-    h2 = _mix_hash(cols, e, 0x9747B28C)
+    # Child counts = parent counts + e_chain, materialized once for the
+    # exact-compare and the final compaction.
+    cc = frontier.counts[parent2] + jax.nn.one_hot(chain2, c, dtype=_I32)
 
-    order = jnp.lexsort((h2, h1, opens.astype(_I32), (~cvalid).astype(_I32)))
-    ccounts, ct, ch, cl, ck, cv = (
-        x[order] for x in (ccounts, ct, ch, cl, ck, cv)
-    )
-    cvalid, opens, h1, h2 = cvalid[order], opens[order], h1[order], h2[order]
+    # Zobrist counts hash, updated incrementally per child.
+    pz1 = _zob_fold(tables.zob1, frontier.counts)  # [F]
+    pz2 = _zob_fold(tables.zob2, frontier.counts)
+    cnt_pc = frontier.counts[parent2, chain2]  # [e2]
+    d1 = tables.zob1[chain2, cnt_pc] ^ tables.zob1[chain2, cnt_pc + 1]
+    d2 = tables.zob2[chain2, cnt_pc] ^ tables.zob2[chain2, cnt_pc + 1]
+    cz1 = pz1[parent2] ^ d1
+    cz2 = pz2[parent2] ^ d2
 
-    eq_prev = jnp.ones(e, bool)
-    for x in (ccounts, ct, ch, cl, ck, cv):
-        eq_prev &= (x == jnp.roll(x, 1, axis=0)).all(axis=1)
-    eq_prev = eq_prev.at[0].set(False)
-    dup = cvalid & jnp.roll(cvalid, 1) & eq_prev
-    keep = cvalid & ~dup
+    hh1 = _mix_hash([cz1, t2, h2, l2, k2], e2, 0x811C9DC5)
+    hh2 = _mix_hash([cz2, t2, h2, l2, k2], e2, 0x9747B28C)
+
+    # Scatter-min hash-table dedup: equal children share both hashes so all
+    # copies land in one slot; the smallest row index wins, copies that
+    # exact-compare equal to the winner drop, unequal collisions re-probe.
+    # Rows still colliding after the probe rounds are kept — a missed merge
+    # wastes a row but never changes a verdict.
+    tsz = 1 << max(1, (e2 - 1).bit_length())
+    idx = jnp.arange(e2, dtype=_I32)
+    keep_u = jnp.zeros(e2, bool)
+    surv = valid2
+    for r in range(3):
+        slot = (hh1 + _U32(r) * (hh2 | _U32(1))) & _U32(tsz - 1)
+        tbl = jnp.full(tsz, e2, _I32).at[slot].min(
+            jnp.where(surv, idx, e2), mode="drop"
+        )
+        win = tbl[slot]
+        w = jnp.minimum(win, e2 - 1)
+        is_win = surv & (win == idx)
+        eq = (
+            (t2 == t2[w])
+            & (h2 == h2[w])
+            & (l2 == l2[w])
+            & (k2 == k2[w])
+            & (cc == cc[w]).all(axis=1)
+        )
+        dup = surv & ~is_win & eq
+        keep_u = keep_u | is_win
+        surv = surv & ~is_win & ~dup
+    keep = keep_u | surv
     n_unique = keep.sum()
 
-    order2 = jnp.lexsort(((~keep).astype(_I32),), axis=0)
-    take = lambda x: x[order2][:f]
-    children = Frontier(
-        counts=take(ccounts),
-        tail=take(ct),
-        hi=take(ch),
-        lo=take(cl),
-        tok=take(ck),
-        svalid=take(cv),
-        valid=keep[order2][:f],
+    # Lazy-order rank: total indefinite appends linearized (fewest first).
+    p_opens = jnp.take_along_axis(
+        tables.opens_tab,
+        jnp.minimum(frontier.counts.T, tables.opens_tab.shape[1] - 1),
+        axis=1,
+    ).sum(axis=0)  # [F]
+    op2 = jnp.concatenate([fl(nxt), fl(nxt)])  # [e2] op linearized per child
+    opens2 = jnp.minimum(
+        p_opens[parent2] + tables.is_indef[op2].astype(_I32), _OPENS_CAP - 1
     )
-    pruned = n_unique > f
-    max_state_set = jnp.where(children.valid, children.svalid.sum(axis=1), 0).max()
-    return children, pruned, overflow, n_unique, expanded, max_state_set
+
+    if allow_prune:
+        # Sort-free beam selection: bincount the priority classes, find the
+        # threshold class, keep lower classes whole and the threshold class
+        # partially (first-come within the layer, deterministic).
+        cnt = jnp.zeros(_OPENS_CAP, _I32).at[opens2].add(keep.astype(_I32))
+        cum = jnp.cumsum(cnt)
+        over = cum > f
+        any_over = over.any()
+        vstar = jnp.argmax(over).astype(_I32)
+        below_ct = jnp.where(vstar > 0, cum[jnp.maximum(vstar - 1, 0)], 0)
+        in_class = keep & (opens2 == vstar)
+        within = jnp.cumsum(in_class.astype(_I32))
+        sel = in_class & (within <= f - below_ct)
+        final_keep = jnp.where(any_over, keep & ((opens2 < vstar) | sel), keep)
+        pruned = any_over
+    else:
+        final_keep = keep
+        pruned = n_unique > f
+
+    pos = jnp.cumsum(final_keep.astype(_I32)) - 1
+    dst = jnp.where(final_keep & (pos < f), pos, e2)
+    children = Frontier(
+        counts=jnp.zeros((f, c), _I32).at[dst].set(cc, mode="drop"),
+        tail=jnp.zeros(f, _U32).at[dst].set(t2, mode="drop"),
+        hi=jnp.zeros(f, _U32).at[dst].set(h2, mode="drop"),
+        lo=jnp.zeros(f, _U32).at[dst].set(l2, mode="drop"),
+        tok=jnp.zeros(f, _I32).at[dst].set(k2, mode="drop"),
+        valid=jnp.zeros(f, bool).at[dst].set(final_keep, mode="drop"),
+    )
+    expanded = cand.sum()
+    return children, pruned, jnp.zeros((), bool), n_unique, expanded, jnp.ones((), _I32)
 
 
 @partial(jax.jit, static_argnames=("allow_prune",))
 def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_prune: bool) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
-    ``allow_prune=True``: capacity overruns prune to the lazy-best
-    configurations and the search continues (OK conclusive; dead ends
-    inconclusive).  ``allow_prune=False``: the loop exits with
-    STOP_CAPACITY and the pre-expansion frontier, so the driver can
-    escalate capacity and resume exactly (no information lost).
+    ``allow_prune=True``: capacity overruns prune to the lazy-best rows and
+    the search continues (OK conclusive; dead ends inconclusive).
+    ``allow_prune=False``: the loop exits with STOP_CAPACITY and the
+    pre-expansion frontier, so the driver can escalate capacity and resume
+    exactly (no information lost).
     """
 
     def body(carry: RunOut) -> RunOut:
         cur = carry.frontier
 
-        closed_counts, ac_n = jax.vmap(partial(_auto_close_one, tables))(
-            cur.counts, cur.tail, cur.tok, cur.svalid, cur.valid
+        closed_counts, ac_n = jax.vmap(partial(_auto_close_row, tables))(
+            cur.counts, cur.tail, cur.tok, cur.valid
         )
         closed = cur._replace(counts=closed_counts)
         acc_row = jax.vmap(partial(_accept_one, tables))(closed.counts, closed.valid)
@@ -495,18 +501,27 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
 
         def do_expand(fr):
             return lax.cond(
-                fastable, partial(_fast_layer, tables), partial(_expand_layer, tables), fr
+                fastable,
+                partial(_fast_layer, tables),
+                partial(_expand_layer, tables, allow_prune=allow_prune),
+                fr,
             )
 
         def no_expand(fr):
             zero = jnp.zeros((), _I32)
             return fr, jnp.zeros((), bool), jnp.zeros((), bool), zero, zero, zero
 
-        # Fast path: a lone live configuration with a single-chain candidate
-        # window — the forced-step regime of low-concurrency stretches.
+        # Fast path: a lone live row with a single-chain candidate window
+        # and a single-successor op — the forced-step regime of
+        # low-concurrency stretches.
         live_idx = jnp.argmax(closed.valid)
-        _, cand1 = _next_and_cands(tables, closed.counts[live_idx])
-        fastable = (closed.valid.sum() == 1) & (cand1.sum() == 1)
+        nxt1, cand1 = _next_and_cands(tables, closed.counts[live_idx])
+        op1 = nxt1[jnp.argmax(cand1)]
+        fastable = (
+            (closed.valid.sum() == 1)
+            & (cand1.sum() == 1)
+            & ~tables.is_indef[op1]
+        )
 
         children, pruned, overflow, n_unique, expanded, mss = lax.cond(
             accept_any, no_expand, do_expand, closed
@@ -550,6 +565,7 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
             expanded=carry.expanded
             + jnp.where(committed, expanded, jnp.zeros((), _I32)),
+            deep_counts=jnp.where(committed, closed.counts[live_idx], carry.deep_counts),
         )
 
     def cond(carry: RunOut):
@@ -564,11 +580,10 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         pruned_ever=jnp.zeros((), bool),
         overflow_ever=jnp.zeros((), bool),
         max_live=frontier.valid.sum().astype(_I32),
-        max_state_set=jnp.where(frontier.valid, frontier.svalid.sum(axis=1), 0)
-        .max()
-        .astype(_I32),
+        max_state_set=jnp.ones((), _I32),
         auto_closed=zero,
         expanded=zero,
+        deep_counts=frontier.counts[0],
     )
     return lax.while_loop(cond, body, init)
 
@@ -593,30 +608,34 @@ def _floor_pow2(n: int, lo: int) -> int:
     return v
 
 
-def _final_states(enc: EncodedHistory, frontier: Frontier, idx: int) -> list[StreamState]:
-    tail = np.asarray(frontier.tail[idx])
-    hi = np.asarray(frontier.hi[idx])
-    lo = np.asarray(frontier.lo[idx])
-    tok = np.asarray(frontier.tok[idx])
-    sv = np.asarray(frontier.svalid[idx])
-    out = []
-    for i in range(sv.shape[0]):
-        if sv[i]:
-            out.append(
-                StreamState(
-                    tail=int(tail[i]),
-                    stream_hash=(int(hi[i]) << 32) | int(lo[i]),
-                    fencing_token=enc.token_of_id[int(tok[i])],
-                )
-            )
+def _final_states(
+    enc: EncodedHistory, frontier: Frontier, idx: int
+) -> list[StreamState]:
+    """States of every valid row sharing the accept row's counts — the
+    accept configuration's candidate-state set."""
+    counts = np.asarray(frontier.counts)
+    valid = np.asarray(frontier.valid)
+    tail = np.asarray(frontier.tail)
+    hi = np.asarray(frontier.hi)
+    lo = np.asarray(frontier.lo)
+    tok = np.asarray(frontier.tok)
+    same = valid & (counts == counts[idx]).all(axis=1)
+    out = {
+        StreamState(
+            tail=int(tail[i]),
+            stream_hash=(int(hi[i]) << 32) | int(lo[i]),
+            fencing_token=enc.token_of_id[int(tok[i])],
+        )
+        for i in np.flatnonzero(same)
+    }
     return sorted(out)
 
 
 def check_device(
     history: History,
     *,
-    max_frontier: int = 4096,
-    state_slots: int = 4,
+    max_frontier: int = 65536,
+    state_slots: int | None = None,
     beam: bool = True,
     start_frontier: int = 16,
     mesh=None,
@@ -626,19 +645,17 @@ def check_device(
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
-    conclusive; a dead end after pruning/overflow is UNKNOWN.
+    conclusive; a dead end after pruning is UNKNOWN.
 
-    Both modes start in a small frontier bucket and escalate (doubling,
-    resuming from the returned pre-expansion frontier) on capacity stops —
-    so cheap histories stay cheap.  At ``max_frontier`` a beam run switches
-    to prune-and-continue (lazy-order beam) inside the compiled loop, while
-    an exhaustive run concedes UNKNOWN.
+    Both modes start in a small frontier bucket and escalate (x4, resuming
+    from the returned pre-expansion frontier) on capacity stops — so cheap
+    histories stay cheap.  At ``max_frontier`` a beam run switches to
+    prune-and-continue (lazy-order beam) inside the compiled loop, while an
+    exhaustive run concedes UNKNOWN.
 
-    Caveat: in a pruning beam run, a per-configuration state-set overflow
-    drops candidate states (OK stays sound — surviving states are genuinely
-    reachable — but ``final_states`` may then be a subset of the host
-    engine's).  ``stats.pruned`` records that this happened
-    (``collect_stats=True``).
+    ``state_slots`` is accepted for API compatibility and ignored: frontier
+    rows are single states, so candidate-state sets are as wide as the
+    frontier itself (they were previously capped by a slot bucket).
 
     ``checkpoint_path``: snapshot the search frontier to this file every
     ``checkpoint_every`` layers (and at capacity escalations) so a long
@@ -646,6 +663,7 @@ def check_device(
     is resumed from, and a conclusive verdict removes it.  A new capability
     over the reference, whose checking is one-shot in-memory (SURVEY.md §5).
     """
+    del state_slots
     enc = encode_history(history)
     stats = FrontierStats()
     if enc.total_remaining == 0:
@@ -661,9 +679,9 @@ def check_device(
     cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
-    f = _round_pow2(min(start_frontier, f_cap), 2)
-    s = _round_pow2(max(len(enc.init_states), state_slots), 2)
-    max_state_slots = 256
+    f = _round_pow2(
+        max(min(start_frontier, f_cap), len(enc.init_states)), 2
+    )
     frontier = None
 
     if checkpoint_path is not None:
@@ -705,7 +723,6 @@ def check_device(
                 hi=jnp.asarray(ck.hi),
                 lo=jnp.asarray(ck.lo),
                 tok=jnp.asarray(ck.tok),
-                svalid=jnp.asarray(ck.svalid),
                 valid=jnp.asarray(ck.valid),
             )
 
@@ -719,7 +736,6 @@ def check_device(
                     hi=np.asarray(fr.hi),
                     lo=np.asarray(fr.lo),
                     tok=np.asarray(fr.tok),
-                    svalid=np.asarray(fr.svalid),
                     valid=np.asarray(fr.valid),
                     f=f,
                     beam=beam,
@@ -736,10 +752,11 @@ def check_device(
         return place_frontier(dev_fr, mesh) if mesh is not None else dev_fr
 
     if frontier is None:
-        frontier = init_frontier(enc, f, s)
+        frontier = init_frontier(enc, f)
     if mesh is not None:
         frontier = place_frontier(frontier, mesh)
 
+    deep_counts = None
     while True:
         allow_prune = beam and f >= f_cap
         layers_budget = cap_layers - stats.layers
@@ -755,10 +772,9 @@ def check_device(
         stats.max_state_set = max(stats.max_state_set, int(out.max_state_set))
         stats.auto_closed += int(out.auto_closed)
         stats.expanded += int(out.expanded)
+        deep_counts = np.asarray(out.deep_counts)
         if allow_prune:
-            stats.pruned = (
-                stats.pruned or bool(out.pruned_ever) or bool(out.overflow_ever)
-            )
+            stats.pruned = stats.pruned or bool(out.pruned_ever)
         code = int(out.stop_code)
         if code == STOP_ACCEPT:
             res = CheckResult(
@@ -769,29 +785,15 @@ def check_device(
             break
         if code == STOP_EMPTY:
             outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
-            res = CheckResult(outcome)
+            res = CheckResult(outcome, deepest=_deepest_ops(enc, deep_counts))
             break
         if code == STOP_CAPACITY:
             # Capacity wall below the cap: escalate and resume from the
             # returned pre-expansion frontier (no information was lost).
             resume = Frontier(*(np.asarray(x) for x in out.frontier))
-            if bool(out.overflow_ever) and resume.state_slots >= max_state_slots:
-                # Widening the frontier cannot fix a per-configuration
-                # state-set overflow.  A beam run jumps straight to the
-                # pruning regime (state drops keep OK sound — see caveat
-                # above); an exhaustive run must concede.
-                if beam and f < f_cap:
-                    f = f_cap
-                    resume = _regrow(resume, f, resume.state_slots)
-                else:
-                    stats.pruned = True
-                    res = CheckResult(CheckOutcome.UNKNOWN)
-                    break
-            elif bool(out.overflow_ever):
-                resume = _regrow(resume, resume.capacity, resume.state_slots * 2)
-            elif f < f_cap:
-                f = min(f * 2, f_cap)
-                resume = _regrow(resume, f, resume.state_slots)
+            if f < f_cap:
+                f = min(f * 4, f_cap)
+                resume = _regrow(resume, f)
             else:
                 stats.pruned = True
                 res = CheckResult(CheckOutcome.UNKNOWN)
@@ -817,33 +819,47 @@ def check_device(
     return res
 
 
-def _regrow(fr: Frontier, capacity: int, state_slots: int) -> Frontier:
-    """Re-pad a frontier into a (capacity, state_slots) bucket."""
+def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
+    """History op indices of the deepest committed row's linearized set."""
+    if deep_counts is None:
+        return list(enc.forced_prefix)
+    chain_ops = np.asarray(enc.chain_ops)
+    out = list(enc.forced_prefix)
+    keep_index = _keep_index(enc)
+    for c in range(chain_ops.shape[0]):
+        for k in range(int(deep_counts[c])):
+            j = int(chain_ops[c, k])
+            if j >= 0:
+                out.append(keep_index[j])
+    return out
+
+
+def _keep_index(enc: EncodedHistory) -> list[int]:
+    """Encoded op index → original History.ops index (inverse of the
+    forced-prefix peel, which keeps relative order)."""
+    forced = set(enc.forced_prefix)
+    n_total = enc.num_ops + len(enc.forced_prefix)
+    return [i for i in range(n_total) if i not in forced]
+
+
+def _regrow(fr: Frontier, capacity: int) -> Frontier:
+    """Re-pad a frontier into a larger capacity bucket."""
     f0, c = np.asarray(fr.counts).shape
-    s0 = fr.state_slots
 
     def grow1(x):
-        out = np.zeros(capacity, np.asarray(x).dtype)
-        out[:f0] = np.asarray(x)
-        return out
-
-    def grow_c(x):
-        out = np.zeros((capacity, c), np.asarray(x).dtype)
-        out[:f0] = np.asarray(x)
-        return out
-
-    def grow_s(x):
-        out = np.zeros((capacity, state_slots), np.asarray(x).dtype)
-        out[:f0, :s0] = np.asarray(x)
+        x = np.asarray(x)
+        out = np.zeros(capacity, x.dtype)
+        out[:f0] = x
         return out
 
     return Frontier(
-        counts=grow_c(fr.counts),
-        tail=grow_s(fr.tail),
-        hi=grow_s(fr.hi),
-        lo=grow_s(fr.lo),
-        tok=grow_s(fr.tok),
-        svalid=grow_s(fr.svalid),
+        counts=np.concatenate(
+            [np.asarray(fr.counts), np.zeros((capacity - f0, c), np.int32)]
+        ),
+        tail=grow1(fr.tail),
+        hi=grow1(fr.hi),
+        lo=grow1(fr.lo),
+        tok=grow1(fr.tok),
         valid=grow1(fr.valid),
     )
 
@@ -851,9 +867,9 @@ def _regrow(fr: Frontier, capacity: int, state_slots: int) -> Frontier:
 def check_device_auto(
     history: History,
     *,
-    beam_width: int = 4096,
-    exhaustive_cap: int = 16384,
-    state_slots: int = 4,
+    beam_width: int = 65536,
+    exhaustive_cap: int = 1 << 20,
+    state_slots: int | None = None,
     mesh=None,
     collect_stats: bool = False,
     checkpoint_path: str | None = None,
@@ -866,6 +882,7 @@ def check_device_auto(
     snapshot must not resume an exhaustive pass, whose soundness rules
     differ); a conceded beam phase leaves a marker so a preempted
     exhaustive phase does not replay the whole beam search on restart."""
+    del state_slots
     marker = f"{checkpoint_path}.beam.conceded" if checkpoint_path else None
     fingerprint = None
     beam_already_conceded = False
@@ -887,7 +904,6 @@ def check_device_auto(
         res = check_device(
             history,
             max_frontier=beam_width,
-            state_slots=state_slots,
             beam=True,
             mesh=mesh,
             collect_stats=collect_stats,
@@ -913,7 +929,6 @@ def check_device_auto(
     res = check_device(
         history,
         max_frontier=exhaustive_cap,
-        state_slots=state_slots,
         beam=False,
         mesh=mesh,
         collect_stats=collect_stats,

@@ -26,6 +26,7 @@ from dataclasses import dataclass
 
 from ..utils import events as ev
 from ..utils.hashing import record_hash
+from .clock import VirtualClock
 from .fake_s2 import FakeS2Stream, FaultPlan
 from .workloads import Ids, HistorySink, WorkloadConfig, run_client
 
@@ -61,6 +62,15 @@ async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent
     sink = HistorySink()
     ids = Ids()
 
+    # Deterministic virtual time: client tasks only yield at sleep points,
+    # and the clock wakes exactly one sleeper at a time in (deadline, seq)
+    # order — so the interleaving, and therefore the history bytes, are a
+    # pure function of the seeds (the reference gets this from turmoil /
+    # Antithesis DST, README.md:5).
+    clock = VirtualClock()
+    if stream.clock is None:
+        stream.clock = clock
+
     # Rectify a non-empty starting stream (collect-history.rs:107-118).
     # Uses the fault-free setup path, like the reference's retrying setup
     # client.
@@ -74,11 +84,25 @@ async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent
         max_client_ids=cfg.max_client_ids,
         indefinite_failure_backoff_s=cfg.indefinite_failure_backoff_s,
     )
-    clients = [
-        run_client(stream, sink, ids, random.Random((cfg.seed << 16) ^ (i + 1)), wcfg)
-        for i in range(cfg.num_concurrent_clients)
-    ]
-    deferred_lists = await asyncio.gather(*clients)
+
+    async def client(i: int) -> list[ev.LabeledEvent]:
+        try:
+            return await run_client(
+                stream,
+                sink,
+                ids,
+                random.Random((cfg.seed << 16) ^ (i + 1)),
+                wcfg,
+                clock=clock,
+            )
+        finally:
+            clock.unregister()
+
+    for _ in range(cfg.num_concurrent_clients):
+        clock.register()
+    deferred_lists = await asyncio.gather(
+        *(client(i) for i in range(cfg.num_concurrent_clients))
+    )
     for deferred in deferred_lists:
         for le in deferred:
             assert isinstance(le.event, ev.AppendIndefiniteFailure)

@@ -15,9 +15,11 @@ classes the collector distinguishes:
   may not have become durable (the coin is flipped internally and never
   revealed to the client).
 
-All randomness flows through one seeded ``random.Random`` so runs are
-replayable, mirroring the reference's AntithesisRng discipline
-(history.rs:58,140).
+All randomness flows through one seeded ``random.Random``, and latency
+sleeps go through the collector's :class:`~.clock.VirtualClock` when one is
+attached, so runs are *byte-replayable* — the interleaving is a function of
+the seeds alone, mirroring the reference's AntithesisRng + turmoil DST
+discipline (history.rs:58,140; README.md:5).
 """
 
 from __future__ import annotations
@@ -103,11 +105,18 @@ class FakeS2Stream:
     faults: FaultPlan = field(default_factory=FaultPlan)
     records: list[_Record] = field(default_factory=list)
     fencing_token: str | None = None
+    #: virtual clock for deterministic interleaving (set by the collector);
+    #: None falls back to real asyncio.sleep
+    clock: object | None = None
 
     async def _latency(self) -> None:
         lo, hi = self.faults.min_latency, self.faults.max_latency
         if hi > 0:
-            await asyncio.sleep(self.rng.uniform(lo, hi))
+            dt = self.rng.uniform(lo, hi)
+            if self.clock is not None:
+                await self.clock.sleep(dt)
+            else:
+                await asyncio.sleep(dt)
 
     @property
     def tail(self) -> int:

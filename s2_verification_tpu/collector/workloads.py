@@ -118,6 +118,7 @@ class _ClientCtx:
     ids: Ids
     rng: random.Random
     cfg: WorkloadConfig
+    clock: object | None = None
     deferred: list[ev.LabeledEvent] = field(default_factory=list)
 
 
@@ -203,7 +204,10 @@ async def _rotate_client_id(ctx: _ClientCtx) -> int | None:
     (the caller stops early, history.rs:152-168).
     """
     if ctx.cfg.indefinite_failure_backoff_s > 0:
-        await asyncio.sleep(ctx.cfg.indefinite_failure_backoff_s)
+        if ctx.clock is not None:
+            await ctx.clock.sleep(ctx.cfg.indefinite_failure_backoff_s)
+        else:
+            await asyncio.sleep(ctx.cfg.indefinite_failure_backoff_s)
     candidate = ctx.ids.take_client_id()
     if candidate < ctx.cfg.max_client_ids:
         return candidate
@@ -216,9 +220,10 @@ async def run_client(
     ids: Ids,
     rng: random.Random,
     cfg: WorkloadConfig,
+    clock=None,
 ) -> list[ev.LabeledEvent]:
     """Run one workload client; returns its deferred (withheld) events."""
-    ctx = _ClientCtx(stream=stream, sink=sink, ids=ids, rng=rng, cfg=cfg)
+    ctx = _ClientCtx(stream=stream, sink=sink, ids=ids, rng=rng, cfg=cfg, clock=clock)
     client_id = ids.take_client_id()
     fencing = cfg.workflow == "fencing"
     match_seq = cfg.workflow == "match-seq-num"

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from . import u64
 from .u64 import U64
-from .xxh3 import fold_record_hashes_masked
+from .xxh3 import fold_record_hashes_indexed
 
 __all__ = ["DeviceState", "DeviceOps", "step_kernel", "states_equal"]
 
@@ -124,13 +124,15 @@ def step_kernel(ops: DeviceOps, op_idx, state: DeviceState):
     guards_ok = token_ok & match_ok
 
     # Optimistic (applied) successor.  The fold is masked by the op's batch
-    # length; non-append rows fold nothing.
-    width = ops.rh_hi.shape[1]
-    lane = jnp.arange(width)
-    mask = lane < ops.rh_len[op_idx]
-    row = ops.rh_row[op_idx]
-    folded = fold_record_hashes_masked(
-        state.stream_hash, U64(ops.rh_hi[row], ops.rh_lo[row]), mask
+    # length; non-append rows fold nothing.  Indexed variant: gathers one
+    # hash-table column per scan step so wide vmaps never materialize a
+    # [lanes, batch] temp.
+    folded = fold_record_hashes_indexed(
+        state.stream_hash,
+        ops.rh_row[op_idx],
+        ops.rh_len[op_idx],
+        ops.rh_hi,
+        ops.rh_lo,
     )
     opt = DeviceState(
         tail=state.tail + ops.num_records[op_idx],

@@ -28,7 +28,12 @@ from jax import lax
 from . import u64
 from .u64 import U64
 
-__all__ = ["xxh3_8byte_seeded", "chain_hash", "fold_record_hashes_masked"]
+__all__ = [
+    "xxh3_8byte_seeded",
+    "chain_hash",
+    "fold_record_hashes_masked",
+    "fold_record_hashes_indexed",
+]
 
 # le_u64(secret[8..16]) ^ le_u64(secret[16..24]) of the default XXH3 secret.
 _BITFLIP_BASE = 0x1CAD21F72C81017C ^ 0xDB979083E96DD4DE
@@ -75,4 +80,24 @@ def fold_record_hashes_masked(stream_hash: U64, record_hashes: U64, mask) -> U64
 
     mask = jnp.asarray(mask, bool)
     acc, _ = lax.scan(step, stream_hash, (record_hashes.hi, record_hashes.lo, mask))
+    return acc
+
+
+def fold_record_hashes_indexed(stream_hash: U64, row, length, rh_hi, rh_lo) -> U64:
+    """Left-fold chain_hash over row ``row`` of the padded ``[R, L]`` hash
+    tables, scanning the *column index* instead of a pre-gathered row.
+
+    Per step the (vmapped) lanes gather one column of the shared tables, so
+    memory stays O(lanes) rather than O(lanes × L) — gathering whole rows
+    per lane materializes a ``[lanes, L]`` temp that XLA hoists out of the
+    scan (observed as the dominant HBM allocation on wide frontiers).
+    ``row``/``length`` are per-lane scalars; padding steps (``i >= length``)
+    leave the accumulator untouched.
+    """
+
+    def step(acc: U64, i):
+        nxt = chain_hash(acc, U64(rh_hi[row, i], rh_lo[row, i]))
+        return u64.select(i < length, nxt, acc), None
+
+    acc, _ = lax.scan(step, stream_hash, jnp.arange(rh_hi.shape[1]))
     return acc

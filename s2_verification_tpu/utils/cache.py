@@ -1,0 +1,51 @@
+"""Persistent XLA compilation cache.
+
+The reference binaries start in O(ms); a fresh CLI invocation of the device
+engine used to pay the full XLA compile (minutes on hard histories) on
+every run.  Enabling JAX's persistent compilation cache makes repeat
+invocations of the same search shapes skip compilation entirely.
+
+Controlled by ``S2VTPU_COMPILE_CACHE``: unset → ``~/.cache/s2vtpu/xla``;
+set to a path → that path; set to empty → disabled.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["enable_persistent_cache"]
+
+_DEFAULT = os.path.join("~", ".cache", "s2vtpu", "xla")
+_enabled: str | None = None
+
+
+def enable_persistent_cache() -> str | None:
+    """Idempotently point JAX at the on-disk compile cache.
+
+    Must run before the first compilation to take effect for it (later
+    compiles still benefit).  Returns the cache dir, or None if disabled
+    or unavailable.
+    """
+    global _enabled
+    if _enabled is not None:
+        return _enabled or None
+    path = os.environ.get("S2VTPU_COMPILE_CACHE")
+    if path is None:
+        path = os.path.expanduser(_DEFAULT)
+    if not path:
+        _enabled = ""
+        return None
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Cache everything that takes noticeable time; the default 1s
+        # floor would skip the many small helper jits.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # pragma: no cover - best-effort: cache is optional
+        _enabled = ""
+        return None
+    _enabled = path
+    return path

@@ -1,0 +1,113 @@
+"""Measure the adversarial-regime curve: native CPU vs device (TPU).
+
+Usage: python scripts/adv_bench.py K[,K...] [--batch B] [--applied A]
+       [--unsat] [--native-budget S] [--oracle-budget S] [--skip-oracle]
+       [--skip-native] [--frontier F] [--start-frontier F0] [--beam]
+
+For each k: builds the k-way ambiguous-append + pinning-read history
+(collector/adversarial.py), runs each engine, prints one summary line per
+engine with wall-clock and outcome.  Device timing reports warm (includes
+compile; persistent cache makes repeats cheap) and steady (second run).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.adversarial import (
+    adversarial_events,
+    ordered_subsets_count,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("ks", help="comma-separated k values")
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument("--applied", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--unsat", action="store_true")
+    ap.add_argument("--native-budget", type=float, default=300.0)
+    ap.add_argument("--oracle-budget", type=float, default=120.0)
+    ap.add_argument("--skip-oracle", action="store_true")
+    ap.add_argument("--skip-native", action="store_true")
+    ap.add_argument("--skip-device", action="store_true")
+    ap.add_argument("--frontier", type=int, default=1 << 21)
+    ap.add_argument("--start-frontier", type=int, default=1 << 12)
+    ap.add_argument("--beam", action="store_true", help="beam instead of exhaustive")
+    ap.add_argument("--once", action="store_true", help="skip the steady-state rerun")
+    args = ap.parse_args()
+
+    for k in [int(x) for x in args.ks.split(",")]:
+        hist = prepare(
+            adversarial_events(
+                k,
+                batch=args.batch,
+                applied=args.applied,
+                seed=args.seed,
+                unsatisfiable=args.unsat,
+            )
+        )
+        want = "ILLEGAL" if args.unsat else "OK"
+        print(
+            f"## k={k} batch={args.batch} applied={args.applied if args.applied is not None else k // 2} "
+            f"unsat={args.unsat} space~{ordered_subsets_count(k)} expect={want}",
+            flush=True,
+        )
+
+        if not args.skip_oracle:
+            from s2_verification_tpu.checker.oracle import check
+
+            t0 = time.monotonic()
+            r = check(hist, time_budget_s=args.oracle_budget)
+            dt = time.monotonic() - t0
+            print(f"oracle  k={k}: {r.outcome.name:8s} {dt:10.3f}s steps={r.steps}", flush=True)
+
+        if not args.skip_native:
+            from s2_verification_tpu.checker.native import check_native
+
+            t0 = time.monotonic()
+            r = check_native(hist, time_budget_s=args.native_budget)
+            dt = time.monotonic() - t0
+            print(f"native  k={k}: {r.outcome.name:8s} {dt:10.3f}s steps={r.steps}", flush=True)
+
+        if not args.skip_device:
+            from s2_verification_tpu.checker.device import check_device
+
+            t0 = time.monotonic()
+            r = check_device(
+                hist,
+                beam=args.beam,
+                max_frontier=args.frontier,
+                start_frontier=args.start_frontier,
+                collect_stats=True,
+            )
+            warm = time.monotonic() - t0
+            steady = warm
+            if not args.once:
+                t0 = time.monotonic()
+                r = check_device(
+                    hist,
+                    beam=args.beam,
+                    max_frontier=args.frontier,
+                    start_frontier=args.start_frontier,
+                    collect_stats=True,
+                )
+                steady = time.monotonic() - t0
+            st = r.stats
+            print(
+                f"device  k={k}: {r.outcome.name:8s} warm={warm:8.3f}s steady={steady:8.3f}s "
+                f"layers={st.layers} max_live={st.max_frontier} expanded={st.expanded}",
+                flush=True,
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -178,11 +178,10 @@ def test_mismatched_history_rejected(hist, tmp_path):
         Checkpoint(
             fingerprint="deadbeef",
             counts=np.zeros((2, enc.num_chains), np.int32),
-            tail=np.zeros((2, 2), np.uint32),
-            hi=np.zeros((2, 2), np.uint32),
-            lo=np.zeros((2, 2), np.uint32),
-            tok=np.zeros((2, 2), np.int32),
-            svalid=np.zeros((2, 2), bool),
+            tail=np.zeros(2, np.uint32),
+            hi=np.zeros(2, np.uint32),
+            lo=np.zeros(2, np.uint32),
+            tok=np.zeros(2, np.int32),
             valid=np.zeros(2, bool),
             f=2,
             beam=False,
