@@ -1,23 +1,36 @@
-"""Benchmark: north-star config from BASELINE.json on the local chip.
+"""Benchmark: north-star configs from BASELINE.json on the local chip.
 
-Collects a 5-client x 2000-op `match-seq-num` history with the seeded fake
-S2, verifies it with the compiled device frontier search, and prints ONE
-JSON line:
+Prints ONE JSON line on stdout (the driver contract):
 
     {"metric": "ops_verified_per_sec_chip", "value": N, "unit": "ops/s",
      "vs_baseline": R}
 
-``value`` is checked-ops / steady-state device wall-clock (first run warms
-the XLA compile cache; the second run is timed — standard JAX practice).
-``vs_baseline`` is the north-star target time (BASELINE.json: verify this
-history in <10 s) divided by the measured device time — ≥1.0 means the
-target is met.  The CPU Wing–Gong oracle's time on the same history is
-reported on stderr for reference (on collector-produced OK histories the
-oracle resolves ambiguity quickly via reads; the device engine's edge is
-worst-case adversarial histories and scale).
+``value`` is checked-ops / steady-state device wall-clock on the 5x2000
+`match-seq-num` collector history (first run warms the XLA compile cache;
+the second run is timed — standard JAX practice).  ``vs_baseline`` is the
+north-star target time (BASELINE.json: <10 s) over the measured time; ≥1.0
+means the target is met.
+
+A SECOND JSON line goes to stderr: the adversarial north-star regime —
+the k-way ambiguous-append history family (collector/adversarial.py) at a
+k where the native C++ Wing–Gong engine cannot finish inside 30 minutes
+(measured curve in BASELINE.md; the in-run native probe reports DNF within
+its short budget).  Its ``vs_baseline`` is 1800 s (the reference CPU's
+30-minute wall) over the device's conclusive wall-clock on that instance —
+the "verify on TPU what CPU Porcupine cannot solve in 30 min" claim,
+measured (/root/reference/README.md:74; BASELINE.json north star).
+
+``--mesh N`` instead runs the multi-chip scaling evidence on a virtual
+N-device CPU mesh (self-provisioned subprocess, same recipe as
+__graft_entry__.dryrun_multichip): the same adversarial search sharded over
+the frontier axis vs unsharded, asserting verdict equality and reporting
+relative layer throughput.  On real multi-chip hardware the same flag
+exercises ICI instead of host memory.
 
 Env knobs (all optional): S2VTPU_BENCH_CLIENTS, S2VTPU_BENCH_OPS,
-S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S.
+S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S, S2VTPU_BENCH_ADV_K,
+S2VTPU_BENCH_ADV_BATCH, S2VTPU_BENCH_ADV_NATIVE_BUDGET_S,
+S2VTPU_BENCH_SKIP_ADV.
 """
 
 from __future__ import annotations
@@ -34,8 +47,12 @@ from s2_verification_tpu.checker.oracle import CheckOutcome, check
 from s2_verification_tpu.collector.collect import CollectConfig, collect_history
 from s2_verification_tpu.collector.fake_s2 import FaultPlan
 
+#: The reference CPU wall the adversarial line is measured against
+#: (BASELINE.json: "CPU Porcupine cannot solve in 30 min").
+CPU_WALL_S = 1800.0
 
-def main() -> int:
+
+def north_star() -> int:
     clients = int(os.environ.get("S2VTPU_BENCH_CLIENTS", "5"))
     ops = int(os.environ.get("S2VTPU_BENCH_OPS", "2000"))
     seed = int(os.environ.get("S2VTPU_BENCH_SEED", "20260729"))
@@ -44,8 +61,7 @@ def main() -> int:
     # Fault rates are tuned to the reference's client-id budget
     # (MAX_CLIENT_IDS=20, history.rs:32): every indefinite append burns one
     # rotation, so the rate must leave the full op count collectable while
-    # still parking ~a dozen open ambiguous appends — the factor that makes
-    # the history adversarial for a Wing–Gong CPU search.
+    # still parking ~a dozen open ambiguous appends.
     events = collect_history(
         CollectConfig(
             num_concurrent_clients=clients,
@@ -66,7 +82,8 @@ def main() -> int:
 
     from s2_verification_tpu.checker.device import check_device_auto
 
-    # Warm-up run compiles every (capacity, slots) bucket this history needs.
+    # Warm-up run compiles (or loads from the persistent cache) every
+    # capacity bucket this history needs.
     t0 = time.monotonic()
     res = check_device_auto(hist)
     warm_s = time.monotonic() - t0
@@ -89,6 +106,12 @@ def main() -> int:
         note = f"timed out at {oracle_budget:.0f}s"
     print(f"# oracle (CPU Wing–Gong): {note}", file=sys.stderr)
 
+    if os.environ.get("S2VTPU_BENCH_SKIP_ADV", "") != "1":
+        try:
+            adversarial_line()
+        except Exception as e:  # auxiliary line must never kill the primary
+            print(f"# adversarial line failed: {e!r}", file=sys.stderr)
+
     target_s = 10.0  # BASELINE.json north star for this config
     value = n_ops / dev_s
     print(
@@ -102,6 +125,163 @@ def main() -> int:
         )
     )
     return 0
+
+
+def adversarial_line() -> None:
+    """The CPU-intractable regime: one conclusive device verdict on an
+    instance past the native engine's 30-minute wall (stderr JSON line)."""
+    from s2_verification_tpu.checker.device import check_device
+    from s2_verification_tpu.collector.adversarial import (
+        adversarial_events,
+        ordered_subsets_count,
+    )
+
+    k = int(os.environ.get("S2VTPU_BENCH_ADV_K", "11"))
+    batch = int(os.environ.get("S2VTPU_BENCH_ADV_BATCH", "100"))
+    native_budget = float(os.environ.get("S2VTPU_BENCH_ADV_NATIVE_BUDGET_S", "60"))
+    hist = prepare(adversarial_events(k, batch=batch, seed=0))
+    print(
+        f"# adversarial k={k}: {len(hist.ops)} ops, "
+        f"~{ordered_subsets_count(k):,} orderings",
+        file=sys.stderr,
+    )
+
+    if native_budget > 0:
+        from s2_verification_tpu.checker.native import check_native
+
+        t0 = time.monotonic()
+        nres = check_native(hist, time_budget_s=native_budget)
+        n_s = time.monotonic() - t0
+        status = nres.outcome.name if nres.outcome != CheckOutcome.UNKNOWN else "DNF"
+        print(
+            f"# native C++ probe: {status} after {n_s:.1f}s "
+            f"(full curve: BASELINE.md; >30 min at this k)",
+            file=sys.stderr,
+        )
+
+    t0 = time.monotonic()
+    res = check_device(hist, max_frontier=1 << 21, start_frontier=1 << 14, beam=False)
+    warm = time.monotonic() - t0
+    t0 = time.monotonic()
+    res = check_device(hist, max_frontier=1 << 21, start_frontier=1 << 14, beam=False)
+    dev_s = time.monotonic() - t0
+    ok = res.outcome == CheckOutcome.OK
+    print(f"# adversarial device: warm {warm:.1f}s, steady {dev_s:.2f}s, {res.outcome.name}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": f"adversarial_k{k}_device_wall_s",
+                "value": round(dev_s, 3) if ok else 0.0,
+                "unit": "s",
+                "vs_baseline": round(CPU_WALL_S / dev_s, 1) if ok else 0.0,
+            }
+        ),
+        file=sys.stderr,
+    )
+
+
+def mesh_scaling(n: int) -> int:
+    """Verdict-equality + layer-throughput at 1 vs n frontier shards.
+
+    The parent must not touch jax (initializing a dead TPU tunnel can hang
+    indefinitely); it always re-execs into a virtual n-device CPU child.
+    To run on real multi-chip hardware instead, set S2VTPU_MESH_CHILD=1
+    with JAX_PLATFORMS pointing at the hardware.
+    """
+    if os.environ.get("S2VTPU_MESH_CHILD") != "1":
+        return _reexec_mesh(n)
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    from s2_verification_tpu.checker.device import check_device
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"mesh child sees {len(jax.devices())} devices, need {n} "
+            "(check XLA_FLAGS / jax_platforms pin)"
+        )
+
+    # CPU meshes (the no-hardware functional check) get a smaller instance:
+    # the point there is verdict equality + the sharded program running, not
+    # absolute throughput.
+    on_cpu = jax.devices()[0].platform == "cpu"
+    k = int(os.environ.get("S2VTPU_BENCH_ADV_K", "5" if on_cpu else "8"))
+    hist = prepare(adversarial_events(k, batch=20 if on_cpu else 50, seed=0))
+    kw = dict(
+        max_frontier=1 << (11 if on_cpu else 17),
+        start_frontier=1 << (9 if on_cpu else 14),
+        beam=False,
+        collect_stats=True,
+        witness=False,
+    )
+
+    res1 = check_device(hist, **kw)  # warm both programs
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("fr",))
+    resn = check_device(hist, mesh=mesh, **kw)
+    assert resn.outcome == res1.outcome, "sharded verdict must match unsharded"
+
+    t0 = time.monotonic()
+    res1 = check_device(hist, **kw)
+    t1 = time.monotonic() - t0
+    t0 = time.monotonic()
+    resn = check_device(hist, mesh=mesh, **kw)
+    tn = time.monotonic() - t0
+    assert resn.outcome == res1.outcome
+    l1 = res1.stats.layers / t1
+    ln = resn.stats.layers / tn
+    print(
+        f"# mesh {n}x: verdicts agree ({res1.outcome.name}); "
+        f"layers/s 1-shard {l1:.2f} vs {n}-shard {ln:.2f}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"mesh_{n}x_layer_throughput_ratio",
+                "value": round(ln / l1, 3),
+                "unit": "x",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    return 0
+
+
+def _reexec_mesh(n: int) -> int:
+    """Child process with a virtual n-device CPU platform (the axon
+    sitecustomize hook overrides the env var, so the config-API pin inside
+    the child is mandatory — same recipe as __graft_entry__)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["S2VTPU_MESH_CHILD"] = "1"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    here = os.path.abspath(__file__)
+    code = (
+        "import sys\n"
+        f"sys.path.insert(0, {os.path.dirname(here)!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        f"raise SystemExit(bench.mesh_scaling({n}))\n"
+    )
+    return subprocess.run([sys.executable, "-c", code], env=env).returncode
+
+
+def main() -> int:
+    if "--mesh" in sys.argv:
+        n = int(sys.argv[sys.argv.index("--mesh") + 1])
+        return mesh_scaling(n)
+    return north_star()
 
 
 if __name__ == "__main__":

@@ -63,6 +63,7 @@ accepts a ``mesh=`` argument.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 from functools import partial
 from typing import NamedTuple
@@ -92,6 +93,8 @@ __all__ = [
 ]
 
 enable_persistent_cache()
+
+log = logging.getLogger("s2_verification_tpu.device")
 
 _I32 = jnp.int32
 _U32 = jnp.uint32
@@ -144,11 +147,18 @@ class RunOut(NamedTuple):
     pruned_ever: jnp.ndarray
     overflow_ever: jnp.ndarray
     max_live: jnp.ndarray
-    max_state_set: jnp.ndarray
     auto_closed: jnp.ndarray
     expanded: jnp.ndarray
     #: counts of one live row of the deepest committed layer (diagnostics)
     deep_counts: jnp.ndarray  # [C] int32
+    #: on STOP_CAPACITY: the aborted layer's unique-children count — the
+    #: driver escalates straight to a bucket that fits it
+    want: jnp.ndarray
+    #: witness log (shape [log_layers, F]; [0, F] when logging is off):
+    #: per committed expansion layer, each child row's parent row index and
+    #: op*2+branch (-1 = no child), for linearization recovery on accept
+    wparent: jnp.ndarray
+    wop: jnp.ndarray
 
 
 STOP_RUNNING, STOP_ACCEPT, STOP_EMPTY, STOP_CAPACITY = 0, 1, 2, 3
@@ -318,13 +328,17 @@ def _fast_layer(tables: SearchTables, frontier: Frontier):
         tok=frontier.tok.at[idx].set(sa.token),
         valid=frontier.valid.at[idx].set(va),
     )
+    f = frontier.valid.shape[0]
+    wparent = jnp.zeros(f, _I32).at[idx].set(idx.astype(_I32))
+    wop = jnp.full(f, -1, _I32).at[idx].set(jnp.where(va, o * 2, -1))
     return (
         children,
         jnp.zeros((), bool),
         jnp.zeros((), bool),
         va.astype(_I32),
         jnp.ones((), _I32),
-        jnp.ones((), _I32),
+        wparent,
+        wop,
     )
 
 
@@ -354,7 +368,9 @@ def _zob_fold(zob, counts):
 
 def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool):
     """Expand + dedup + compact one layer.  Returns (children, pruned,
-    overflow, n_unique, expanded, max_state_set)."""
+    overflow, n_unique, expanded, wparent, wop) — the last two are the
+    per-child witness-log row: parent row index and op*2+branch (-1 =
+    no child), used to walk an accepting path back for the linearization."""
     f, c = frontier.counts.shape
     ops = tables.ops
 
@@ -376,12 +392,16 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
 
     e = f * c
     e2 = 2 * e
-    parent = jnp.repeat(jnp.arange(f, dtype=_I32), c)  # [e]
-    chain = jnp.tile(jnp.arange(c, dtype=_I32), f)  # [e]
+    # Index maps from iota arithmetic, NOT repeat/tile of arange: XLA
+    # constant-folds those into O(F*C) literals embedded in the executable,
+    # which made compile time, cache size, and cache-load time scale with
+    # frontier capacity (35 MB executables at F=65536).
+    idx2 = lax.iota(_I32, e2)
+    within = lax.rem(idx2, _I32(e))
+    parent2 = within // _I32(c)
+    chain2 = lax.rem(within, _I32(c))
     fl = lambda x: x.reshape(e)
-
-    parent2 = jnp.concatenate([parent, parent])
-    chain2 = jnp.concatenate([chain, chain])
+    parent = parent2[:e]
     t2 = jnp.concatenate([fl(sa.tail), frontier.tail[parent]])
     h2 = jnp.concatenate([fl(sa.hash_hi), frontier.hi[parent]])
     l2 = jnp.concatenate([fl(sa.hash_lo), frontier.lo[parent]])
@@ -410,7 +430,7 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     # Rows still colliding after the probe rounds are kept — a missed merge
     # wastes a row but never changes a verdict.
     tsz = 1 << max(1, (e2 - 1).bit_length())
-    idx = jnp.arange(e2, dtype=_I32)
+    idx = idx2
     keep_u = jnp.zeros(e2, bool)
     surv = valid2
     for r in range(3):
@@ -475,11 +495,21 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
         valid=jnp.zeros(f, bool).at[dst].set(final_keep, mode="drop"),
     )
     expanded = cand.sum()
-    return children, pruned, jnp.zeros((), bool), n_unique, expanded, jnp.ones((), _I32)
+    opbr = op2 * 2 + (idx2 >= e).astype(_I32)
+    wparent = jnp.zeros(f, _I32).at[dst].set(parent2, mode="drop")
+    wop = jnp.full(f, -1, _I32).at[dst].set(opbr, mode="drop")
+    return children, pruned, jnp.zeros((), bool), n_unique, expanded, wparent, wop
 
 
-@partial(jax.jit, static_argnames=("allow_prune",))
-def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_prune: bool) -> RunOut:
+@partial(jax.jit, static_argnames=("allow_prune", "log_layers"))
+def run_search(
+    tables: SearchTables,
+    frontier: Frontier,
+    max_layers,
+    *,
+    allow_prune: bool,
+    log_layers: int = 0,
+) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
     ``allow_prune=True``: capacity overruns prune to the lazy-best rows and
@@ -487,6 +517,12 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
     ``allow_prune=False``: the loop exits with STOP_CAPACITY and the
     pre-expansion frontier, so the driver can escalate capacity and resume
     exactly (no information lost).
+
+    ``log_layers > 0`` additionally records, for each of the first
+    ``log_layers`` committed expansion layers, every child row's (parent
+    row, op*2+branch) — the witness log the driver walks backwards from the
+    accept row to recover a concrete linearization.  The caller must keep
+    ``max_layers <= log_layers``.
     """
 
     def body(carry: RunOut) -> RunOut:
@@ -507,9 +543,19 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
                 fr,
             )
 
+        f = frontier.valid.shape[0]
+
         def no_expand(fr):
             zero = jnp.zeros((), _I32)
-            return fr, jnp.zeros((), bool), jnp.zeros((), bool), zero, zero, zero
+            return (
+                fr,
+                jnp.zeros((), bool),
+                jnp.zeros((), bool),
+                zero,
+                zero,
+                jnp.zeros(f, _I32),
+                jnp.full(f, -1, _I32),
+            )
 
         # Fast path: a lone live row with a single-chain candidate window
         # and a single-successor op — the forced-step regime of
@@ -523,7 +569,7 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             & ~tables.is_indef[op1]
         )
 
-        children, pruned, overflow, n_unique, expanded, mss = lax.cond(
+        children, pruned, overflow, n_unique, expanded, wparent, wop = lax.cond(
             accept_any, no_expand, do_expand, closed
         )
         empty = ~accept_any & (n_unique == 0)
@@ -546,6 +592,17 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         # from the pre-expansion frontier and replays it), so only committed
         # layers contribute to the counters — resumed stats stay exact.
         committed = ~need_cap
+        if log_layers:
+            # The accept layer's row is all -1 (no expansion ran); a
+            # capacity-stop row is overwritten on resume because ``layers``
+            # does not advance past it.
+            li = jnp.minimum(carry.layers, log_layers - 1)
+            new_wparent = lax.dynamic_update_index_in_dim(
+                carry.wparent, wparent, li, 0
+            )
+            new_wop = lax.dynamic_update_index_in_dim(carry.wop, wop, li, 0)
+        else:
+            new_wparent, new_wop = carry.wparent, carry.wop
         return RunOut(
             frontier=nxt,
             stop_code=stop,
@@ -556,9 +613,6 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             max_live=jnp.maximum(
                 carry.max_live, jnp.where(committed, children.valid.sum(), 0)
             ),
-            max_state_set=jnp.maximum(
-                carry.max_state_set, jnp.where(committed, mss, 0)
-            ),
             # auto_closed stays ungated: the resume frontier handed back on a
             # capacity stop is post-auto-close, so that work IS committed and
             # will not be replayed.
@@ -566,6 +620,9 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
             expanded=carry.expanded
             + jnp.where(committed, expanded, jnp.zeros((), _I32)),
             deep_counts=jnp.where(committed, closed.counts[live_idx], carry.deep_counts),
+            want=jnp.where(need_cap, n_unique, carry.want),
+            wparent=new_wparent,
+            wop=new_wop,
         )
 
     def cond(carry: RunOut):
@@ -580,10 +637,12 @@ def run_search(tables: SearchTables, frontier: Frontier, max_layers, *, allow_pr
         pruned_ever=jnp.zeros((), bool),
         overflow_ever=jnp.zeros((), bool),
         max_live=frontier.valid.sum().astype(_I32),
-        max_state_set=jnp.ones((), _I32),
         auto_closed=zero,
         expanded=zero,
         deep_counts=frontier.counts[0],
+        want=zero,
+        wparent=jnp.zeros((log_layers, frontier.valid.shape[0]), _I32),
+        wop=jnp.full((log_layers, frontier.valid.shape[0]), -1, _I32),
     )
     return lax.while_loop(cond, body, init)
 
@@ -631,6 +690,9 @@ def _final_states(
     return sorted(out)
 
 
+_WITNESS_CHUNK = 512
+
+
 def check_device(
     history: History,
     *,
@@ -642,6 +704,8 @@ def check_device(
     collect_stats: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
+    witness: bool = True,
+    witness_max_frontier: int = 4096,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -662,6 +726,15 @@ def check_device(
     search survives preemption; an existing snapshot for the *same* history
     is resumed from, and a conclusive verdict removes it.  A new capability
     over the reference, whose checking is one-shot in-memory (SURVEY.md §5).
+
+    ``witness``: record a per-layer (parent row, op, branch) log inside the
+    compiled loop and, on accept, walk it backwards + replay it forwards to
+    recover a concrete linearization (the analog of the linearization info
+    ``porcupine.CheckEventsVerbose`` hands ``Visualize``, main.go:605-631).
+    Logging is dropped — the verdict is unaffected — once the frontier
+    escalates past ``witness_max_frontier`` (the log costs O(layers x F)
+    device memory) or when resuming from a checkpoint (earlier layers'
+    logs are gone).
     """
     del state_slots
     enc = encode_history(history)
@@ -717,6 +790,8 @@ def check_device(
             for k, v in ck.stats.items():
                 setattr(stats, k, v)
             stats.layers = ck.layers_done
+            # Earlier layers' witness logs predate this process.
+            witness = witness and stats.layers == 0
             frontier = Frontier(
                 counts=jnp.asarray(ck.counts),
                 tail=jnp.asarray(ck.tail),
@@ -756,30 +831,77 @@ def check_device(
     if mesh is not None:
         frontier = place_frontier(frontier, mesh)
 
+    log.debug(
+        "device search: %d ops over %d chains, frontier=%d (cap %d), %s",
+        enc.num_ops,
+        enc.num_chains,
+        f,
+        f_cap,
+        "beam" if beam else "exhaustive",
+    )
+    wlogs: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
     deep_counts = None
     while True:
         allow_prune = beam and f >= f_cap
+        if witness and f > witness_max_frontier:
+            log.debug(
+                "witness log dropped: frontier %d exceeds witness cap %d",
+                f,
+                witness_max_frontier,
+            )
+            witness = False
+            wlogs = []
         layers_budget = cap_layers - stats.layers
         if checkpoint_path is not None and checkpoint_every > 0:
             layers_budget = min(layers_budget, checkpoint_every)
+        if witness:
+            layers_budget = min(layers_budget, _WITNESS_CHUNK)
         out = jax.device_get(
             run_search(
-                tables, frontier, np.int32(layers_budget), allow_prune=allow_prune
+                tables,
+                frontier,
+                np.int32(layers_budget),
+                allow_prune=allow_prune,
+                log_layers=_WITNESS_CHUNK if witness else 0,
             )
+        )
+        log.debug(
+            "segment done: stop=%s layers=%d/%d live=%d auto_closed=%d expanded=%d",
+            ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[int(out.stop_code)],
+            stats.layers + int(out.layers),
+            cap_layers,
+            int(out.frontier.valid.sum()),
+            stats.auto_closed + int(out.auto_closed),
+            stats.expanded + int(out.expanded),
         )
         stats.layers += int(out.layers)
         stats.max_frontier = max(stats.max_frontier, int(out.max_live))
-        stats.max_state_set = max(stats.max_state_set, int(out.max_state_set))
+        # max_state_set stays 0: frontier rows are single states, so the
+        # candidate-set-width statistic is meaningful only for host engines.
         stats.auto_closed += int(out.auto_closed)
         stats.expanded += int(out.expanded)
         deep_counts = np.asarray(out.deep_counts)
         if allow_prune:
             stats.pruned = stats.pruned or bool(out.pruned_ever)
         code = int(out.stop_code)
+        if witness:
+            # Committed expansion layers of this segment, sparsified.  The
+            # accept layer expands nothing (its log row is all -1) and a
+            # capacity-aborted layer is not committed; neither is consumed.
+            n_rows = int(out.layers) - (1 if code == STOP_ACCEPT else 0)
+            wp, wo = np.asarray(out.wparent), np.asarray(out.wop)
+            for l in range(n_rows):
+                rows = np.flatnonzero(wo[l] >= 0)
+                wlogs.append((rows, wp[l][rows], wo[l][rows]))
         if code == STOP_ACCEPT:
+            lin = (
+                _witness_linearization(enc, wlogs, int(out.accept_idx))
+                if witness
+                else None
+            )
             res = CheckResult(
                 CheckOutcome.OK,
-                linearization=None,
+                linearization=lin,
                 final_states=_final_states(enc, out.frontier, int(out.accept_idx)),
             )
             break
@@ -792,7 +914,13 @@ def check_device(
             # returned pre-expansion frontier (no information was lost).
             resume = Frontier(*(np.asarray(x) for x in out.frontier))
             if f < f_cap:
-                f = min(f * 4, f_cap)
+                # Jump straight to a bucket that fits the aborted layer's
+                # children (x2 headroom) instead of stepping x4 through
+                # intermediate buckets — each distinct capacity is its own
+                # XLA program, so skipped buckets are skipped compiles.
+                need = _round_pow2(max(int(out.want) * 2, f * 4), 2)
+                f = min(need, f_cap)
+                log.debug("capacity stop: escalating frontier to %d and resuming", f)
                 resume = _regrow(resume, f)
             else:
                 stats.pruned = True
@@ -819,27 +947,146 @@ def check_device(
     return res
 
 
+def _host_close(enc: EncodedHistory, counts, tail: int, tok: int) -> list[int]:
+    """Host mirror of :func:`_auto_close_row`: advance every dead candidate
+    (all at once per sweep, chain order within a sweep) until a fixpoint;
+    returns the encoded op indices closed, mutating ``counts``."""
+    is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
+    settable = {int(enc.set_token[j]) for j in range(enc.num_ops) if enc.has_set_token[j]}
+    closed: list[int] = []
+    while True:
+        nxt, cand = _host_next_cands(enc, counts)
+        dead = []
+        for c in np.flatnonzero(cand):
+            j = nxt[c]
+            if not is_indef[j]:
+                continue
+            if enc.has_match[j] and tail > int(enc.match_seq[j]):
+                dead.append(c)
+            elif (
+                enc.has_batch_token[j]
+                and int(enc.batch_token[j]) not in settable
+                and tok != int(enc.batch_token[j])
+            ):
+                dead.append(c)
+        if not dead:
+            return closed
+        for c in dead:
+            closed.append(int(nxt[c]))
+        for c in dead:
+            counts[c] += 1
+
+
+def _host_next_cands(enc: EncodedHistory, counts):
+    """Host mirror of :func:`_next_and_cands` for one counts vector."""
+    c = enc.num_chains
+    nxt = np.zeros(c, np.int64)
+    has_next = counts < enc.chain_len
+    m = INF_TIME
+    for ci in range(c):
+        if has_next[ci]:
+            nxt[ci] = enc.chain_ops[ci, counts[ci]]
+            m = min(m, int(enc.ret[nxt[ci]]))
+    cand = has_next & (enc.call[nxt] < m)
+    return nxt, cand
+
+
+def _witness_linearization(
+    enc: EncodedHistory, wlogs, accept_idx: int
+) -> list[int] | None:
+    """Recover a concrete linearization from the accept row's logged path.
+
+    Walk the per-layer (parent, op, branch) log backwards from the accept
+    row to the initial row, then replay forwards — re-running the
+    deterministic auto-close between logged steps so closed ops land at
+    their true positions — and finish with the accept configuration's
+    remaining (all-indefinite-append) ops in call order, which is always a
+    valid completion.  Returns ``History.ops`` indices in linearization
+    order, or None if the log is inconsistent (never expected; the caller
+    then just omits the witness, matching the verdict-only behavior).
+    """
+    path: list[int] = []  # opbr per expansion layer, first → last
+    r = accept_idx
+    for rows, parents, opbrs in reversed(wlogs):
+        i = np.searchsorted(rows, r)
+        if i >= len(rows) or rows[i] != r:
+            log.warning("witness log inconsistent at row %d; omitting witness", r)
+            return None
+        path.append(int(opbrs[i]))
+        r = int(parents[i])
+    path.reverse()
+
+    states = sorted(intern_state(enc, s) for s in enc.init_states)
+    if r >= len(states):
+        log.warning("witness walk ended at invalid init row %d", r)
+        return None
+    tail, hi, lo, tok = states[r]
+    h = (hi << 32) | lo
+
+    from ..utils.hashing import fold_record_hashes
+
+    is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
+    counts = np.array(enc.chain_start, np.int64)
+    order: list[int] = []
+
+    def apply_effect(j: int) -> None:
+        nonlocal tail, h, tok
+        if enc.op_type[j] == 0 and not (enc.out_failure[j] and enc.out_definite[j]):
+            row, ln = int(enc.rh_row[j]), int(enc.rh_len[j])
+            hashes = [
+                (int(enc.rh_hi[row, i]) << 32) | int(enc.rh_lo[row, i])
+                for i in range(ln)
+            ]
+            h = fold_record_hashes(h, hashes)
+            tail = (tail + int(enc.num_records[j])) & 0xFFFFFFFF
+            if enc.has_set_token[j]:
+                tok = int(enc.set_token[j])
+
+    for opbr in path:
+        j, br = opbr // 2, opbr % 2
+        order.extend(_host_close(enc, counts, tail, tok))
+        nxt, cand = _host_next_cands(enc, counts)
+        c = int(enc.chain_of[j])
+        if not cand[c] or int(nxt[c]) != j:
+            log.warning("witness replay diverged at op %d; omitting witness", j)
+            return None
+        counts[c] += 1
+        order.append(j)
+        if br == 0:
+            apply_effect(j)
+    order.extend(_host_close(enc, counts, tail, tok))
+
+    # The accept configuration's remaining ops are all indefinite appends;
+    # linearizing them in call order respects both chain order and real time
+    # (each remaining op's no-effect branch is unconditionally valid).
+    remaining = [
+        int(enc.chain_ops[c, k])
+        for c in range(enc.num_chains)
+        for k in range(int(counts[c]), int(enc.chain_len[c]))
+    ]
+    if not all(is_indef[j] for j in remaining):
+        log.warning("witness accept state has non-indefinite remainders")
+        return None
+    remaining.sort(key=lambda j: int(enc.call[j]))
+    order.extend(remaining)
+
+    ki = enc.keep_index()
+    return list(enc.forced_prefix) + [ki[j] for j in order]
+
+
 def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
     """History op indices of the deepest committed row's linearized set."""
     if deep_counts is None:
         return list(enc.forced_prefix)
     chain_ops = np.asarray(enc.chain_ops)
     out = list(enc.forced_prefix)
-    keep_index = _keep_index(enc)
+    keep_index = enc.keep_index()
     for c in range(chain_ops.shape[0]):
         for k in range(int(deep_counts[c])):
             j = int(chain_ops[c, k])
             if j >= 0:
                 out.append(keep_index[j])
     return out
-
-
-def _keep_index(enc: EncodedHistory) -> list[int]:
-    """Encoded op index → original History.ops index (inverse of the
-    forced-prefix peel, which keeps relative order)."""
-    forced = set(enc.forced_prefix)
-    n_total = enc.num_ops + len(enc.forced_prefix)
-    return [i for i in range(n_total) if i not in forced]
 
 
 def _regrow(fr: Frontier, capacity: int) -> Frontier:
@@ -874,6 +1121,8 @@ def check_device_auto(
     collect_stats: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
+    witness: bool = True,
+    witness_max_frontier: int = 4096,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
@@ -911,6 +1160,8 @@ def check_device_auto(
                 f"{checkpoint_path}.beam" if checkpoint_path is not None else None
             ),
             checkpoint_every=checkpoint_every,
+            witness=witness,
+            witness_max_frontier=witness_max_frontier,
         )
         if res.outcome != CheckOutcome.UNKNOWN:
             if marker is not None:
@@ -934,6 +1185,8 @@ def check_device_auto(
         collect_stats=collect_stats,
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every,
+        witness=witness,
+        witness_max_frontier=witness_max_frontier,
     )
     # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
     # paired with the kept exhaustive snapshot: a retry (e.g. with a larger

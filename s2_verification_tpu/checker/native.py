@@ -199,8 +199,7 @@ def check_native(
 
     # Encoded op index → History.ops index (forced-prefix ops were peeled
     # off before encoding).
-    forced_set = set(enc.forced_prefix)
-    keep_index = [op.index for op in history.ops if op.index not in forced_set]
+    keep_index = enc.keep_index()
 
     if rc != 0:
         outcome = CheckOutcome.UNKNOWN if rc == 2 else CheckOutcome.ILLEGAL

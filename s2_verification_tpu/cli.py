@@ -88,11 +88,18 @@ def _run_backend(
     time_budget_s: float | None,
     checkpoint: str | None = None,
 ) -> CheckResult:
-    if checkpoint is not None and backend not in ("device", "auto"):
+    # Budget 0 = run to completion, the reference's unbounded default
+    # (CheckEventsVerbose timeout 0, main.go:606).
+    unbounded = time_budget_s is not None and time_budget_s <= 0
+    if unbounded:
+        time_budget_s = None
+    if checkpoint is not None and (
+        backend not in ("device", "auto") or (backend == "auto" and unbounded)
+    ):
         log.warning(
             "-checkpoint only applies to the device search; the %s backend "
             "will not snapshot",
-            backend,
+            f"{backend} (unbounded CPU)" if backend == "auto" else backend,
         )
     if backend == "oracle":
         return check(hist, time_budget_s=time_budget_s)
@@ -109,6 +116,9 @@ def _run_backend(
 
         return check_device_auto(hist, checkpoint_path=checkpoint)
     if backend == "auto":
+        if unbounded:
+            # Never concede a decidable instance: CPU runs to completion.
+            return _cpu_check(hist, None)
         budget = time_budget_s if time_budget_s is not None else 10.0
         res = _cpu_check(hist, budget)
         if res.outcome != CheckOutcome.UNKNOWN:
@@ -237,7 +247,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--time-budget",
         type=float,
         default=None,
-        help="oracle time budget in seconds (auto backend default: 10)",
+        help="CPU-engine time budget in seconds; 0 = run to completion, the "
+        "reference's unbounded default (auto backend default: 10)",
     )
     c.add_argument("-out-dir", "--out-dir", default="./porcupine-outputs")
     c.add_argument(

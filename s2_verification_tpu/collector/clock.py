@@ -20,7 +20,20 @@ from __future__ import annotations
 import asyncio
 import heapq
 
-__all__ = ["VirtualClock"]
+__all__ = ["VirtualClock", "vsleep"]
+
+
+async def vsleep(clock: "VirtualClock | None", dt: float) -> None:
+    """Sleep on the virtual clock when one is attached, else in real time.
+
+    The single chokepoint for every collector-side sleep: any new sleep site
+    must route through here, or it silently bypasses the virtual clock and
+    breaks byte-deterministic replay.
+    """
+    if clock is not None:
+        await clock.sleep(dt)
+    else:
+        await asyncio.sleep(dt)
 
 
 class VirtualClock:
@@ -51,7 +64,13 @@ class VirtualClock:
             self._active += 1
 
     def _maybe_advance(self) -> None:
-        if self._active == 0 and self._heap:
+        while self._active == 0 and self._heap:
             deadline, _, fut = heapq.heappop(self._heap)
             self.now = max(self.now, deadline)
-            fut.set_result(None)
+            # A parked sleeper may have been cancelled by task teardown
+            # (e.g. a sibling client raised); setting its result would raise
+            # InvalidStateError and mask the original error — skip it and
+            # wake the next sleeper instead.
+            if not fut.done():
+                fut.set_result(None)
+                return

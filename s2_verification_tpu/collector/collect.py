@@ -19,6 +19,7 @@ clients stop.  Mirrors the reference binary's lifecycle
 from __future__ import annotations
 
 import asyncio
+import logging
 import os
 import random
 import time
@@ -31,6 +32,8 @@ from .fake_s2 import FakeS2Stream, FaultPlan
 from .workloads import Ids, HistorySink, WorkloadConfig, run_client
 
 __all__ = ["CollectConfig", "collect_history", "collect_to_file"]
+
+log = logging.getLogger("s2_verification_tpu.collector")
 
 
 @dataclass
@@ -68,14 +71,22 @@ async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent
     # pure function of the seeds (the reference gets this from turmoil /
     # Antithesis DST, README.md:5).
     clock = VirtualClock()
-    if stream.clock is None:
-        stream.clock = clock
+    # Attach this run's clock unconditionally: a stream reused across runs
+    # (the rectifying-append scenario) would otherwise keep the previous
+    # run's clock, parking this run's tasks on a scheduler that can never
+    # advance (its registered-task count is already drained) — a deadlock.
+    prev_clock = stream.clock
+    stream.clock = clock
 
     # Rectify a non-empty starting stream (collect-history.rs:107-118).
     # Uses the fault-free setup path, like the reference's retrying setup
     # client.
     existing = [record_hash(b) for b in stream.snapshot_bodies()]
     if existing:
+        log.debug(
+            "stream starts non-empty (tail=%d); emitting rectifying append",
+            len(existing),
+        )
         initialize_tail(sink, ids.take_op_id(), len(existing), existing)
 
     wcfg = WorkloadConfig(
@@ -100,8 +111,18 @@ async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent
 
     for _ in range(cfg.num_concurrent_clients):
         clock.register()
-    deferred_lists = await asyncio.gather(
-        *(client(i) for i in range(cfg.num_concurrent_clients))
+    try:
+        deferred_lists = await asyncio.gather(
+            *(client(i) for i in range(cfg.num_concurrent_clients))
+        )
+    finally:
+        stream.clock = prev_clock
+    n_deferred = sum(len(d) for d in deferred_lists)
+    log.debug(
+        "all clients done: %d events collected, flushing %d deferred "
+        "indefinite-failure finishes",
+        len(sink.events),
+        n_deferred,
     )
     for deferred in deferred_lists:
         for le in deferred:

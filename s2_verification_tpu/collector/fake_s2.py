@@ -24,11 +24,14 @@ discipline (history.rs:58,140; README.md:5).
 
 from __future__ import annotations
 
-import asyncio
+import logging
 import random
 from dataclasses import dataclass, field
 
 from ..utils.hashing import record_hash
+from .clock import vsleep
+
+log = logging.getLogger("s2_verification_tpu.fake_s2")
 
 __all__ = [
     "AppendConditionFailed",
@@ -112,11 +115,7 @@ class FakeS2Stream:
     async def _latency(self) -> None:
         lo, hi = self.faults.min_latency, self.faults.max_latency
         if hi > 0:
-            dt = self.rng.uniform(lo, hi)
-            if self.clock is not None:
-                await self.clock.sleep(dt)
-            else:
-                await asyncio.sleep(dt)
+            await vsleep(self.clock, self.rng.uniform(lo, hi))
 
     @property
     def tail(self) -> int:
@@ -143,14 +142,19 @@ class FakeS2Stream:
         # secret applied/not-applied coin is part of the atomic step.
         r = self.rng.random()
         if r < self.faults.p_append_definite:
+            log.debug("inject: definite append failure (rate_limited)")
             await self._latency()
             raise DefiniteServerError("rate_limited")
         if r < self.faults.p_append_definite + self.faults.p_append_indefinite:
-            if (
+            applied = (
                 self._preconditions_hold(match_seq_num, fencing_token)
                 and self.rng.random() < self.faults.p_indefinite_applied
-            ):
+            )
+            if applied:
                 self._apply(bodies, set_fencing_token)
+            log.debug(
+                "inject: indefinite append failure (secretly applied=%s)", applied
+            )
             await self._latency()
             raise IndefiniteServerError("deadline_exceeded")
         if not self._preconditions_hold(match_seq_num, fencing_token):
@@ -182,6 +186,7 @@ class FakeS2Stream:
         """Read every record body from the head (seq 0) through the tail."""
         await self._latency()
         if self.rng.random() < self.faults.p_read_fail:
+            log.debug("inject: read failure")
             raise ReadError("stream reset")
         bodies = [r.body for r in self.records]
         await self._latency()
@@ -190,6 +195,7 @@ class FakeS2Stream:
     async def check_tail(self) -> int:
         await self._latency()
         if self.rng.random() < self.faults.p_check_tail_fail:
+            log.debug("inject: check_tail failure")
             raise CheckTailError("unavailable")
         t = self.tail
         await self._latency()

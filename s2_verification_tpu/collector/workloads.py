@@ -27,12 +27,14 @@ Shared mechanics, faithful to the reference:
 from __future__ import annotations
 
 import asyncio
+import logging
 import random
 import string
 from dataclasses import dataclass, field
 
 from ..utils import events as ev
 from ..utils.hashing import record_hash, stream_hash_of_bodies
+from .clock import vsleep
 from .fake_s2 import (
     AppendConditionFailed,
     CheckTailError,
@@ -43,6 +45,11 @@ from .fake_s2 import (
 )
 
 __all__ = ["WorkloadConfig", "Ids", "HistorySink", "run_client", "WORKFLOWS"]
+
+#: Narrates every op at DEBUG (kind, guards, outcome) the way the
+#: reference's RUST_LOG=trace spans do (history.rs:408-439,509,570);
+#: enable via S2VTPU_LOG=DEBUG on the CLI.
+log = logging.getLogger("s2_verification_tpu.collector")
 
 MAX_BATCH_BYTES = 1024
 PER_RECORD_OVERHEAD = 8
@@ -168,6 +175,19 @@ async def _append(
         ctx.deferred.append(ev.LabeledEvent(finish, client_id, op_id))
     else:
         ctx.sink.send(ev.LabeledEvent(finish, client_id, op_id))
+    log.debug(
+        "client=%d op=%d append records=%d match_seq_num=%s token=%s set_token=%s -> %s%s",
+        client_id,
+        op_id,
+        len(bodies),
+        match_seq_num,
+        fencing_token,
+        set_fencing_token,
+        type(finish).__name__,
+        " (finish deferred; op stays open)"
+        if isinstance(finish, ev.AppendIndefiniteFailure)
+        else "",
+    )
     return finish
 
 
@@ -182,6 +202,7 @@ async def _read(ctx: _ClientCtx, client_id: int, op_id: int) -> ev.Finish:
     except ReadError:
         finish = ev.ReadFailure()
     ctx.sink.send(ev.LabeledEvent(finish, client_id, op_id))
+    log.debug("client=%d op=%d read -> %s", client_id, op_id, finish)
     return finish
 
 
@@ -194,6 +215,7 @@ async def _check_tail(ctx: _ClientCtx, client_id: int, op_id: int) -> ev.Finish:
     except CheckTailError:
         finish = ev.CheckTailFailure()
     ctx.sink.send(ev.LabeledEvent(finish, client_id, op_id))
+    log.debug("client=%d op=%d check_tail -> %s", client_id, op_id, finish)
     return finish
 
 
@@ -204,13 +226,15 @@ async def _rotate_client_id(ctx: _ClientCtx) -> int | None:
     (the caller stops early, history.rs:152-168).
     """
     if ctx.cfg.indefinite_failure_backoff_s > 0:
-        if ctx.clock is not None:
-            await ctx.clock.sleep(ctx.cfg.indefinite_failure_backoff_s)
-        else:
-            await asyncio.sleep(ctx.cfg.indefinite_failure_backoff_s)
+        await vsleep(ctx.clock, ctx.cfg.indefinite_failure_backoff_s)
     candidate = ctx.ids.take_client_id()
     if candidate < ctx.cfg.max_client_ids:
+        log.debug("rotated to fresh client id %d after indefinite failure", candidate)
         return candidate
+    log.debug(
+        "client id budget exhausted (max_client_ids=%d); stopping this client",
+        ctx.cfg.max_client_ids,
+    )
     return None
 
 

@@ -90,6 +90,13 @@ class EncodedHistory:
     def total_remaining(self) -> int:
         return int((self.chain_len - self.chain_start).sum())
 
+    def keep_index(self) -> list[int]:
+        """Encoded op index → original ``History.ops`` index (inverse of the
+        forced-prefix peel, which keeps relative order)."""
+        forced = set(self.forced_prefix)
+        n_total = self.num_ops + len(self.forced_prefix)
+        return [i for i in range(n_total) if i not in forced]
+
 
 def _forced_prefix(history: History) -> tuple[list[int], list[StreamState]]:
     """Ops that must linearize first, and the state set after applying them.

@@ -218,23 +218,34 @@ def encode_event(le: LabeledEvent) -> str:
 
 
 _U64_MAX = (1 << 64) - 1
+#: Tails, match_seq_num and num_records are u32 in the model
+#: (golang/s2-porcupine/main.go:196-225).  The Go checker decodes them as
+#: ``int`` and then converts with ``uint32(...)`` (main.go:428-520), which
+#: silently *wraps* out-of-range values — a wrapped tail would change a
+#: verdict without any diagnostic.  A verification tool must not guess, so
+#: values outside u32 are rejected at decode instead.
+_U32_MAX = (1 << 32) - 1
 
 
-def _require_int(obj: object, key: str, ctx: str, u64: bool = False) -> int:
+def _require_int(
+    obj: object, key: str, ctx: str, u64: bool = False, u32: bool = False
+) -> int:
     if not isinstance(obj, dict):
         raise DecodeError(f"{ctx}: expected an object body, got {obj!r}")
     v = obj.get(key)
     if not isinstance(v, int) or isinstance(v, bool):
         raise DecodeError(f"{ctx}: expected integer {key!r}, got {v!r}")
-    if v < 0 or (u64 and v > _U64_MAX):
+    if v < 0 or (u64 and v > _U64_MAX) or (u32 and v > _U32_MAX):
         raise DecodeError(f"{ctx}: {key!r} out of range: {v}")
     return v
 
 
-def _opt_int(obj: dict, key: str, ctx: str, u64: bool = False) -> int | None:
+def _opt_int(
+    obj: dict, key: str, ctx: str, u64: bool = False, u32: bool = False
+) -> int | None:
     if obj.get(key) is None:
         return None
-    return _require_int(obj, key, ctx, u64=u64)
+    return _require_int(obj, key, ctx, u64=u64, u32=u32)
 
 
 def _opt_str(obj: dict, key: str, ctx: str) -> str | None:
@@ -264,8 +275,8 @@ def _decode_start(data: object) -> Start:
                 for h in hashes
             ):
                 raise DecodeError("record_hashes must be a list of u64 integers")
-            num = _require_int(args, "num_records", "Append", u64=True)
-            match = _opt_int(args, "match_seq_num", "Append", u64=True)
+            num = _require_int(args, "num_records", "Append", u32=True)
+            match = _opt_int(args, "match_seq_num", "Append", u32=True)
             try:
                 return AppendStart(
                     num_records=num,
@@ -293,17 +304,17 @@ def _decode_finish(data: object) -> Finish:
     if isinstance(data, dict):
         if "AppendSuccess" in data:
             body = data["AppendSuccess"]
-            return AppendSuccess(tail=_require_int(body, "tail", "AppendSuccess", u64=True))
+            return AppendSuccess(tail=_require_int(body, "tail", "AppendSuccess", u32=True))
         if "ReadSuccess" in data:
             body = data["ReadSuccess"]
             return ReadSuccess(
-                tail=_require_int(body, "tail", "ReadSuccess", u64=True),
+                tail=_require_int(body, "tail", "ReadSuccess", u32=True),
                 stream_hash=_require_int(body, "stream_hash", "ReadSuccess", u64=True),
             )
         if "CheckTailSuccess" in data:
             body = data["CheckTailSuccess"]
             return CheckTailSuccess(
-                tail=_require_int(body, "tail", "CheckTailSuccess", u64=True)
+                tail=_require_int(body, "tail", "CheckTailSuccess", u32=True)
             )
     raise DecodeError("unknown finish event format")
 

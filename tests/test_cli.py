@@ -148,3 +148,38 @@ def test_usage_error_exit64(capsys):
     with pytest.raises(SystemExit) as e:
         main(["check", "-backend", "bogus"])
     assert e.value.code == 64
+
+
+def test_time_budget_zero_runs_to_completion(history_path):
+    # Budget 0 mirrors the reference's unbounded CheckEventsVerbose timeout
+    # (main.go:606): the CPU engine runs to a conclusive verdict instead of
+    # returning UNKNOWN (exit 2) the instant the budget expires.
+    for backend in ("oracle", "auto"):
+        rc = main(
+            [
+                "check",
+                "-file",
+                history_path,
+                "-backend",
+                backend,
+                "-time-budget",
+                "0",
+                "-no-viz",
+            ]
+        )
+        assert rc == 0, backend
+
+
+def test_viz_annotates_device_linearization(history_path):
+    # Device-checked OK must render ordinals in the HTML exactly like the
+    # oracle path (the reference always gets linearization info from
+    # CheckEventsVerbose for Visualize, main.go:605-631).
+    from s2_verification_tpu.checker.device import check_device
+
+    events = ev.read_history(history_path)
+    checked = prepare(events)
+    full = prepare(events, elide_trivial=False)
+    res = check_device(checked, max_frontier=4096, start_frontier=16)
+    assert res.ok and res.linearization is not None
+    html_text = render_html(full, res, checked=checked)
+    assert html_text.count('<span class="ord">') == len(checked.ops)

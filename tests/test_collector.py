@@ -149,3 +149,49 @@ def test_distinct_seeds_differ():
     ev.write_history(collect_history(cfg(seed=1)), a)
     ev.write_history(collect_history(cfg(seed=2)), b)
     assert a.getvalue() != b.getvalue()
+
+
+def test_debug_narration(caplog):
+    # S2VTPU_LOG=DEBUG narrates the run like RUST_LOG=trace does for the
+    # reference (history.rs:408-439): per-op outcomes, injected faults,
+    # rotations, and the deferred-finish flush.
+    import logging
+
+    with caplog.at_level(logging.DEBUG, logger="s2_verification_tpu"):
+        collect_history(
+            CollectConfig(
+                num_concurrent_clients=3,
+                num_ops_per_client=20,
+                workflow="match-seq-num",
+                seed=11,
+                faults=FaultPlan.chaos(0.3),
+            )
+        )
+    text = caplog.text
+    assert "append" in text and "-> Append" in text
+    assert "inject:" in text
+    assert "flushing" in text
+
+
+def test_stream_reuse_across_collections_does_not_deadlock():
+    # Regression: a stream reused across runs (rectifying-append scenario)
+    # kept the first run's virtual clock; the second run's clients then
+    # parked on a scheduler that could never advance — a deadlock.
+    import random
+
+    stream = FakeS2Stream(rng=random.Random(3), faults=FaultPlan.chaos(0.3))
+    cfg = CollectConfig(
+        num_concurrent_clients=2, num_ops_per_client=10, seed=9,
+        faults=FaultPlan.chaos(0.3),
+    )
+    first = collect_history(cfg, stream)
+    assert stream.clock is None  # restored after the run
+    second = collect_history(CollectConfig(
+        num_concurrent_clients=2, num_ops_per_client=10, seed=10,
+        faults=FaultPlan.chaos(0.3),
+    ), stream)
+    assert first and second
+    # The second history starts from the non-empty stream: rectified.
+    from s2_verification_tpu.checker.entries import prepare
+    from s2_verification_tpu.checker.oracle import check
+    assert check(prepare(second)).ok

@@ -182,3 +182,82 @@ def test_device_sharded_over_mesh():
 def test_device_empty_history():
     hist = prepare([])
     assert check_device(hist).outcome == CheckOutcome.OK
+
+
+def _assert_valid_linearization(hist, order):
+    """Independent witness validation: the order must cover every op exactly
+    once, extend the real-time partial order (A.ret < B.call ⇒ A before B),
+    and drive a non-empty candidate-state set through every step."""
+    from s2_verification_tpu.models.stream import INIT_STATE, step_set
+
+    ops = hist.ops
+    assert sorted(order) == list(range(len(ops)))
+    pos = {j: i for i, j in enumerate(order)}
+    for a in ops:
+        for b in ops:
+            if a.ret < b.call:
+                assert pos[a.index] < pos[b.index], (a.index, b.index)
+    states = [INIT_STATE]
+    for j in order:
+        states = step_set(states, ops[j].inp, ops[j].out)
+        assert states, f"empty state set linearizing op {j}"
+
+
+def test_device_witness_on_random_histories():
+    # The accept-path witness must be a genuine linearization — validated
+    # independently (coverage, real-time order, non-empty state sets) — the
+    # analog of CheckEventsVerbose's linearization info (main.go:605-631).
+    rng = random.Random(0x717)
+    checked = 0
+    for trial in range(40):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        got = check_device(hist, max_frontier=256, start_frontier=16, beam=False)
+        if got.outcome == CheckOutcome.OK:
+            assert got.linearization is not None, f"trial {trial}"
+            _assert_valid_linearization(hist, got.linearization)
+            checked += 1
+    assert checked >= 5
+
+
+@pytest.mark.parametrize("workflow", ["regular", "match-seq-num", "fencing"])
+def test_device_witness_on_collected_histories(workflow):
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=15,
+            workflow=workflow,
+            seed=23,
+            faults=FaultPlan.chaos(0.25),
+        )
+    )
+    hist = prepare(events)
+    # start_frontier=2 forces capacity escalations mid-run, exercising the
+    # witness log across segment boundaries and _regrow row preservation.
+    res = check_device(hist, max_frontier=4096, start_frontier=2, beam=False)
+    assert res.outcome == CheckOutcome.OK
+    assert res.linearization is not None
+    _assert_valid_linearization(hist, res.linearization)
+
+
+def test_device_witness_adversarial():
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(5, batch=4, seed=1))
+    res = check_device(hist, max_frontier=4096, start_frontier=16, beam=False)
+    assert res.outcome == CheckOutcome.OK
+    assert res.linearization is not None
+    _assert_valid_linearization(hist, res.linearization)
+
+
+def test_device_witness_dropped_beyond_cap():
+    # Past witness_max_frontier the log is dropped but the verdict stands.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(5, batch=4, seed=1))
+    res = check_device(
+        hist, max_frontier=4096, start_frontier=16, beam=False,
+        witness_max_frontier=16,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert res.linearization is None

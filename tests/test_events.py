@@ -176,3 +176,54 @@ def test_multi_value_stream_with_whitespace():
     assert len(events) == 2
     assert events[0].event == ReadStart()
     assert events[1].event == ReadSuccess(0, 0)
+
+
+def test_tails_wider_than_u32_rejected():
+    # The model's Tail/MatchSeqNum/NumRecords are u32
+    # (golang/s2-porcupine/main.go:196-225); the Go checker's uint32(...)
+    # conversions would silently wrap wider values (main.go:428-520), which
+    # could flip a verdict — we reject at decode instead.
+    u32_max = (1 << 32) - 1
+    ok = {
+        "event": {"Finish": {"AppendSuccess": {"tail": u32_max}}},
+        "client_id": 0,
+        "op_id": 0,
+    }
+    assert decode_obj(ok).event == AppendSuccess(tail=u32_max)
+    for finish in (
+        {"AppendSuccess": {"tail": u32_max + 1}},
+        {"ReadSuccess": {"tail": u32_max + 1, "stream_hash": 0}},
+        {"CheckTailSuccess": {"tail": u32_max + 1}},
+    ):
+        with pytest.raises(DecodeError, match="out of range"):
+            decode_obj({"event": {"Finish": finish}, "client_id": 0, "op_id": 0})
+    start = {
+        "Append": {
+            "num_records": 1,
+            "record_hashes": [0],
+            "set_fencing_token": None,
+            "fencing_token": None,
+            "match_seq_num": u32_max + 1,
+        }
+    }
+    with pytest.raises(DecodeError, match="out of range"):
+        decode_obj({"event": {"Start": start}, "client_id": 0, "op_id": 0})
+
+
+def test_stream_hash_still_full_u64():
+    # stream_hash stays u64 (main.go:201-204): the full xxh3 chain hash.
+    big = (1 << 64) - 1
+    obj = {
+        "event": {"Finish": {"ReadSuccess": {"tail": 3, "stream_hash": big}}},
+        "client_id": 0,
+        "op_id": 0,
+    }
+    assert decode_obj(obj).event == ReadSuccess(tail=3, stream_hash=big)
+    with pytest.raises(DecodeError, match="out of range"):
+        decode_obj(
+            {
+                "event": {"Finish": {"ReadSuccess": {"tail": 3, "stream_hash": big + 1}}},
+                "client_id": 0,
+                "op_id": 0,
+            }
+        )
