@@ -87,6 +87,7 @@ def main() -> int:
                 max_frontier=args.frontier,
                 start_frontier=args.start_frontier,
                 collect_stats=True,
+                witness=False,
             )
             warm = time.monotonic() - t0
             steady = warm
@@ -98,6 +99,7 @@ def main() -> int:
                     max_frontier=args.frontier,
                     start_frontier=args.start_frontier,
                     collect_stats=True,
+                    witness=False,
                 )
                 steady = time.monotonic() - t0
             st = r.stats
