@@ -52,7 +52,53 @@ from s2_verification_tpu.collector.fake_s2 import FaultPlan
 CPU_WALL_S = 1800.0
 
 
+def _zero_line(note: str) -> int:
+    print(f"# {note}", file=sys.stderr)
+    print(
+        json.dumps(
+            {"metric": "ops_verified_per_sec_chip", "value": 0.0, "unit": "ops/s", "vs_baseline": 0.0}
+        ),
+        flush=True,
+    )
+    return 1
+
+
 def north_star() -> int:
+    # The axon TPU tunnel has been observed to go down in a way that makes
+    # backend init HANG rather than error (and SIGALRM cannot interrupt the
+    # blocking C init); a hung bench stalls the whole driver, so probe the
+    # backend in a subprocess with a hard timeout first and emit a
+    # parseable zero line with a diagnostic if it wedges.
+    import subprocess
+
+    probe_s = float(os.environ.get("S2VTPU_BENCH_INIT_TIMEOUT_S", "300"))
+    if probe_s > 0:
+        try:
+            # The axon sitecustomize hook overrides JAX_PLATFORMS, so the
+            # child must re-pin it through the config API for CPU runs.
+            probe = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import os, jax\n"
+                    "p = os.environ.get('JAX_PLATFORMS')\n"
+                    "if p: jax.config.update('jax_platforms', p)\n"
+                    "jax.devices()",
+                ],
+                timeout=probe_s,
+                capture_output=True,
+            )
+        except subprocess.TimeoutExpired:
+            return _zero_line(
+                f"backend init probe hung >{probe_s:.0f}s; TPU tunnel down?"
+            )
+        if probe.returncode != 0:
+            err = probe.stderr.decode(errors="replace").strip().splitlines()
+            return _zero_line(
+                "backend init probe failed: "
+                + (err[-1] if err else f"rc={probe.returncode}, no stderr")
+            )
+
     clients = int(os.environ.get("S2VTPU_BENCH_CLIENTS", "5"))
     ops = int(os.environ.get("S2VTPU_BENCH_OPS", "2000"))
     seed = int(os.environ.get("S2VTPU_BENCH_SEED", "20260729"))

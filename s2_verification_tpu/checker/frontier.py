@@ -89,6 +89,7 @@ def check_frontier(
     max_frontier: int | None = None,
     beam: bool = False,
     collect_stats: bool = False,
+    witness: bool = True,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
 
@@ -98,6 +99,11 @@ def check_frontier(
     pruning is still sound (any accepting path proves linearizability); a
     dead end after pruning is inconclusive and reported UNKNOWN — callers
     escalate to an exhaustive pass (see :func:`check_frontier_auto`).
+
+    ``witness=True`` keeps parent links for every configuration generated so
+    an accepting path can be walked back into a concrete linearization —
+    O(visited configs) extra memory (comparable to the DFS memo cache);
+    pass ``witness=False`` for verdict-only runs.
     """
     ops = history.ops
     chains = history.chains
@@ -114,10 +120,42 @@ def check_frontier(
     )
 
     init_counts = tuple(0 for _ in range(n_chains))
+    init_cfg = (init_counts, frozenset([INIT_STATE]))
     frontier: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {
-        (init_counts, frozenset([INIT_STATE])): None
+        init_cfg: None
     }
+    # Witness links: cfg -> (parent cfg, ops auto-closed at the parent's
+    # layer, the expanded op) — walked backwards on accept to recover a
+    # concrete linearization (same role as the device engine's witness log).
+    parents: dict = {init_cfg: None} if witness else {}
     target = tuple(len(c) for c in chains)
+    # Deepest committed prefix across the whole search (diagnostics parity
+    # with the oracle's global best, oracle.py:130).
+    deep_counts = init_counts
+    deep_sum = sum(init_counts)
+
+    def walk(cfg) -> list[int]:
+        rev: list[int] = []
+        while parents[cfg] is not None:
+            cfg, closed_ops, op_index = parents[cfg]
+            rev.append(op_index)
+            rev.extend(reversed(closed_ops))
+        rev.reverse()
+        return rev
+
+    def completion(counts) -> list[int]:
+        # Remaining ops are all indefinite appends: call order respects both
+        # chain order and real time, and each no-effect step is valid.
+        rest = [
+            chains[c][k]
+            for c in range(n_chains)
+            for k in range(counts[c], len(chains[c]))
+        ]
+        rest.sort(key=lambda j: ops[j].call)
+        return rest
+
+    def deepest_of(counts) -> list[int]:
+        return [chains[c][k] for c in range(n_chains) for k in range(counts[c])]
 
     # Per-chain prefix counts of indefinite appends, for the relaxed
     # acceptance test and the lazy beam ranking.
@@ -169,8 +207,9 @@ def check_frontier(
         return m, cands
 
     def auto_close_config(counts, states):
+        closed_ops: list[int] = []
         if not auto_close:
-            return counts, states
+            return counts, states, closed_ops
         counts = list(counts)
         changed = True
         while changed:
@@ -179,10 +218,11 @@ def check_frontier(
             for c in cands:
                 op = next_op(tuple(counts), c)
                 if _op_dead_forever(op, states, settable_tokens):
+                    closed_ops.append(chains[c][counts[c]])
                     counts[c] += 1
                     stats.auto_closed += 1
                     changed = True
-        return tuple(counts), states
+        return tuple(counts), states, closed_ops
 
     layer = 0
     while True:
@@ -191,15 +231,32 @@ def check_frontier(
         stats.max_frontier = max(stats.max_frontier, len(frontier))
 
         closed: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
+        #: post-close cfg -> (pre-close cfg, ops closed getting there)
+        close_link: dict = {}
         for counts, states in frontier:
-            counts, states = auto_close_config(counts, states)
-            closed[(counts, states)] = None
+            pre = (counts, states)
+            counts, states, closed_ops = auto_close_config(counts, states)
+            key = (counts, states)
+            if key not in closed:
+                closed[key] = None
+                close_link[key] = (pre, closed_ops)
 
         for counts, states in closed:
+            csum = sum(counts)
+            if csum > deep_sum:
+                deep_sum, deep_counts = csum, counts
             if accepting(counts):
                 stats.max_state_set = max(stats.max_state_set, len(states))
+                if witness:
+                    pre, closed_ops = close_link[(counts, states)]
+                    order = walk(pre) + closed_ops + completion(counts)
+                else:
+                    order = None
                 res = CheckResult(
-                    CheckOutcome.OK, linearization=None, final_states=sorted(states)
+                    CheckOutcome.OK,
+                    linearization=order,
+                    deepest=order or [],
+                    final_states=sorted(states),
                 )
                 if collect_stats:
                     res.stats = stats  # type: ignore[attr-defined]
@@ -207,6 +264,7 @@ def check_frontier(
 
         children: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
         for counts, states in closed:
+            pre, closed_ops = close_link[(counts, states)]
             _, cands = window(counts)
             for c in cands:
                 op = next_op(counts, c)
@@ -216,11 +274,15 @@ def check_frontier(
                     continue
                 stats.max_state_set = max(stats.max_state_set, len(new_states))
                 child_counts = counts[:c] + (counts[c] + 1,) + counts[c + 1 :]
-                children[(child_counts, frozenset(new_states))] = None
+                child = (child_counts, frozenset(new_states))
+                if child not in children:
+                    children[child] = None
+                    if witness and child not in parents:
+                        parents[child] = (pre, tuple(closed_ops), chains[c][counts[c]])
 
         if not children:
             outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
-            res = CheckResult(outcome)
+            res = CheckResult(outcome, deepest=deepest_of(deep_counts))
             if collect_stats:
                 res.stats = stats  # type: ignore[attr-defined]
             return res
@@ -243,6 +305,7 @@ def check_frontier_auto(
     beam_width: int = 4096,
     exhaustive_cap: int | None = None,
     collect_stats: bool = False,
+    witness: bool = True,
 ) -> CheckResult:
     """Beam-first frontier check with exhaustive escalation.
 
@@ -256,6 +319,7 @@ def check_frontier_auto(
         max_frontier=beam_width,
         beam=True,
         collect_stats=collect_stats,
+        witness=witness,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
@@ -263,4 +327,5 @@ def check_frontier_auto(
         history,
         max_frontier=exhaustive_cap,
         collect_stats=collect_stats,
+        witness=witness,
     )

@@ -122,3 +122,47 @@ def test_frontier_unknown_on_budget():
     hist = prepare(h.events)
     res = check_frontier(hist, max_frontier=2)
     assert res.outcome == CheckOutcome.UNKNOWN
+
+
+def test_frontier_witness_is_valid():
+    # The frontier engine's accept-path witness (parity with the device
+    # engine's): covers every op once, extends real time, keeps state sets
+    # non-empty.
+    import random
+
+    from test_device import _assert_valid_linearization
+    from test_oracle_bruteforce import random_history
+
+    rng = random.Random(0xF17)
+    checked = 0
+    for _ in range(40):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        res = check_frontier(hist)
+        if res.outcome == CheckOutcome.OK:
+            assert res.linearization is not None
+            _assert_valid_linearization(hist, res.linearization)
+            checked += 1
+    assert checked >= 5
+
+
+def test_frontier_witness_opt_out_and_deepest():
+    import random
+
+    from test_oracle_bruteforce import random_history
+
+    rng = random.Random(0xD33)
+    saw_ok = saw_illegal = False
+    for _ in range(60):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        res = check_frontier(hist, witness=False)
+        if res.outcome == CheckOutcome.OK:
+            assert res.linearization is None  # verdict-only mode
+            saw_ok = True
+        elif res.outcome == CheckOutcome.ILLEGAL and hist.ops:
+            # deepest is the globally deepest committed prefix: a real
+            # subset of ops, each index valid.
+            assert all(0 <= j < len(hist.ops) for j in res.deepest)
+            saw_illegal = True
+    assert saw_ok and saw_illegal
