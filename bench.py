@@ -15,10 +15,14 @@ A SECOND JSON line goes to stderr: the adversarial north-star regime —
 the k-way ambiguous-append history family (collector/adversarial.py) at a
 k where the native C++ Wing–Gong engine cannot finish inside 30 minutes
 (measured curve in BASELINE.md; the in-run native probe reports DNF within
-its short budget).  Its ``vs_baseline`` is 1800 s (the reference CPU's
-30-minute wall) over the device's conclusive wall-clock on that instance —
-the "verify on TPU what CPU Porcupine cannot solve in 30 min" claim,
-measured (/root/reference/README.md:74; BASELINE.json north star).
+its short budget).  Its ``vs_baseline`` is the native engine's wall-clock
+on the same instance — the live probe time when it finished, else the
+measured batch=100 curve, capped at 1800 s (the 30-minute wall, which
+k>=12 exceeds) — over the device's conclusive wall-clock: the "verify on
+TPU what CPU Porcupine cannot solve in 30 min" claim, measured
+(/root/reference/README.md:74; BASELINE.json north star).  When neither a
+finished probe nor a curve entry exists for the configured (k, batch), the
+ratio is reported as 0.0 (no baseline claim).
 
 ``--mesh N`` instead runs the multi-chip scaling evidence on a virtual
 N-device CPU mesh (self-provisioned subprocess, same recipe as
@@ -51,6 +55,11 @@ from s2_verification_tpu.collector.fake_s2 import FaultPlan
 #: (BASELINE.json: "CPU Porcupine cannot solve in 30 min").
 CPU_WALL_S = 1800.0
 
+#: Measured native C++ Wing–Gong wall-clock on the adversarial family
+#: (batch=100, seed=0; BASELINE.md curve).  k=12 exceeded its 1814 s
+#: budget — past the 30-minute wall — so its entry is the wall itself.
+NATIVE_WALL_S = {8: 3.4, 9: 24.7, 10: 85.4, 11: 391.2, 12: CPU_WALL_S}
+
 
 def _zero_line(note: str) -> int:
     print(f"# {note}", file=sys.stderr)
@@ -73,10 +82,17 @@ def north_star() -> int:
 
     probe_s = float(os.environ.get("S2VTPU_BENCH_INIT_TIMEOUT_S", "300"))
     if probe_s > 0:
-        try:
+        import tempfile
+
+        # No pipes: a killed-but-wedged child (or a libtpu grandchild
+        # inheriting them) would keep a pipe open and block communicate()
+        # forever — the very hang the probe exists to bound.  Output goes
+        # to a temp file; the child gets its own process group so the
+        # whole tree can be killed.
+        with tempfile.TemporaryFile() as out:
             # The axon sitecustomize hook overrides JAX_PLATFORMS, so the
             # child must re-pin it through the config API for CPU runs.
-            probe = subprocess.run(
+            child = subprocess.Popen(
                 [
                     sys.executable,
                     "-c",
@@ -85,19 +101,27 @@ def north_star() -> int:
                     "if p: jax.config.update('jax_platforms', p)\n"
                     "jax.devices()",
                 ],
-                timeout=probe_s,
-                capture_output=True,
+                stdout=out,
+                stderr=out,
+                start_new_session=True,
             )
-        except subprocess.TimeoutExpired:
-            return _zero_line(
-                f"backend init probe hung >{probe_s:.0f}s; TPU tunnel down?"
-            )
-        if probe.returncode != 0:
-            err = probe.stderr.decode(errors="replace").strip().splitlines()
-            return _zero_line(
-                "backend init probe failed: "
-                + (err[-1] if err else f"rc={probe.returncode}, no stderr")
-            )
+            try:
+                rc = child.wait(timeout=probe_s)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                with __import__("contextlib").suppress(ProcessLookupError):
+                    os.killpg(child.pid, signal.SIGKILL)
+                return _zero_line(
+                    f"backend init probe hung >{probe_s:.0f}s; TPU tunnel down?"
+                )
+            if rc != 0:
+                out.seek(0)
+                err = out.read().decode(errors="replace").strip().splitlines()
+                return _zero_line(
+                    "backend init probe failed: "
+                    + (err[-1] if err else f"rc={rc}, no output")
+                )
 
     clients = int(os.environ.get("S2VTPU_BENCH_CLIENTS", "5"))
     ops = int(os.environ.get("S2VTPU_BENCH_OPS", "2000"))
@@ -134,9 +158,7 @@ def north_star() -> int:
     res = check_device_auto(hist)
     warm_s = time.monotonic() - t0
     if res.outcome != CheckOutcome.OK:
-        print(f"# device outcome {res.outcome} (expected OK)", file=sys.stderr)
-        print(json.dumps({"metric": "ops_verified_per_sec_chip", "value": 0.0, "unit": "ops/s", "vs_baseline": 0.0}))
-        return 1
+        return _zero_line(f"device outcome {res.outcome} (expected OK)")
     t0 = time.monotonic()
     res2 = check_device_auto(hist)
     dev_s = time.monotonic() - t0
@@ -216,16 +238,33 @@ def adversarial_line() -> None:
             f"# adversarial device: warm {warm:.1f}s, steady {dev_s:.2f}s, OK",
             file=sys.stderr,
         )
+        probe_finished_s = None
         if native_budget > 0:
             from s2_verification_tpu.checker.native import check_native
 
             t0 = time.monotonic()
             nres = check_native(hist, time_budget_s=native_budget)
             n_s = time.monotonic() - t0
-            status = nres.outcome.name if nres.outcome != CheckOutcome.UNKNOWN else "DNF"
+            if nres.outcome != CheckOutcome.UNKNOWN:
+                status = nres.outcome.name
+                probe_finished_s = n_s
+            else:
+                status = "DNF"
             print(
                 f"# native C++ probe: {status} after {n_s:.1f}s "
                 f"(full curve: BASELINE.md)",
+                file=sys.stderr,
+            )
+        # vs_baseline is honest per-(k, batch): the live native time when
+        # the probe finished, else the measured batch=100 curve (capped at
+        # the 30-minute wall, which k>=12 exceeds); 0.0 when neither
+        # applies — no baseline claim rather than an inflated one.
+        native_wall = probe_finished_s
+        if native_wall is None and batch == 100 and k in NATIVE_WALL_S:
+            native_wall = min(NATIVE_WALL_S[k], CPU_WALL_S)
+        if native_wall is None:
+            print(
+                f"# no native baseline for k={k} batch={batch}; vs_baseline=0",
                 file=sys.stderr,
             )
         print(
@@ -234,7 +273,9 @@ def adversarial_line() -> None:
                     "metric": f"adversarial_k{k}_device_wall_s",
                     "value": round(dev_s, 3),
                     "unit": "s",
-                    "vs_baseline": round(CPU_WALL_S / dev_s, 1),
+                    "vs_baseline": round(native_wall / dev_s, 1)
+                    if native_wall is not None
+                    else 0.0,
                 }
             ),
             file=sys.stderr,

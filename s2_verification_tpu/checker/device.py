@@ -408,10 +408,6 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     k2 = jnp.concatenate([fl(sa.token), frontier.tok[parent]])
     valid2 = jnp.concatenate([fl(va), fl(vb)])
 
-    # Child counts = parent counts + e_chain, materialized once for the
-    # exact-compare and the final compaction.
-    cc = frontier.counts[parent2] + jax.nn.one_hot(chain2, c, dtype=_I32)
-
     # Zobrist counts hash, updated incrementally per child.
     pz1 = _zob_fold(tables.zob1, frontier.counts)  # [F]
     pz2 = _zob_fold(tables.zob2, frontier.counts)
@@ -441,12 +437,19 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
         win = tbl[slot]
         w = jnp.minimum(win, e2 - 1)
         is_win = surv & (win == idx)
+        # Counts equality is tested as same-chain + equal parent counts —
+        # never materializing the [e2, C] child-counts matrix (the largest
+        # buffer of the old layer; it capped the frontier well below HBM).
+        # A cross-chain coincidence (different chains stepping different
+        # parents to identical child counts) is not merged; missed merges
+        # only cost capacity, never soundness.
         eq = (
             (t2 == t2[w])
             & (h2 == h2[w])
             & (l2 == l2[w])
             & (k2 == k2[w])
-            & (cc == cc[w]).all(axis=1)
+            & (chain2 == chain2[w])
+            & (frontier.counts[parent2] == frontier.counts[parent2[w]]).all(axis=1)
         )
         dup = surv & ~is_win & eq
         keep_u = keep_u | is_win
@@ -486,18 +489,28 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
 
     pos = jnp.cumsum(final_keep.astype(_I32)) - 1
     dst = jnp.where(final_keep & (pos < f), pos, e2)
+    opbr = op2 * 2 + (idx2 >= e).astype(_I32)
+    wparent = jnp.zeros(f, _I32).at[dst].set(parent2, mode="drop")
+    wop = jnp.full(f, -1, _I32).at[dst].set(opbr, mode="drop")
+    valid_next = jnp.zeros(f, bool).at[dst].set(final_keep, mode="drop")
+    # Child counts are recomputed per selected row from the compacted
+    # (parent, chain) maps — an [F, C] gather instead of an [e2, C] scatter.
+    sel_chain = jnp.zeros(f, _I32).at[dst].set(chain2, mode="drop")
+    counts_next = jnp.where(
+        valid_next[:, None],
+        frontier.counts[wparent]
+        + (sel_chain[:, None] == lax.iota(_I32, c)[None, :]).astype(_I32),
+        0,
+    )
     children = Frontier(
-        counts=jnp.zeros((f, c), _I32).at[dst].set(cc, mode="drop"),
+        counts=counts_next,
         tail=jnp.zeros(f, _U32).at[dst].set(t2, mode="drop"),
         hi=jnp.zeros(f, _U32).at[dst].set(h2, mode="drop"),
         lo=jnp.zeros(f, _U32).at[dst].set(l2, mode="drop"),
         tok=jnp.zeros(f, _I32).at[dst].set(k2, mode="drop"),
-        valid=jnp.zeros(f, bool).at[dst].set(final_keep, mode="drop"),
+        valid=valid_next,
     )
     expanded = cand.sum()
-    opbr = op2 * 2 + (idx2 >= e).astype(_I32)
-    wparent = jnp.zeros(f, _I32).at[dst].set(parent2, mode="drop")
-    wop = jnp.full(f, -1, _I32).at[dst].set(opbr, mode="drop")
     return children, pruned, jnp.zeros((), bool), n_unique, expanded, wparent, wop
 
 

@@ -288,7 +288,9 @@ def check_frontier(
             return res
         if max_frontier is not None and len(children) > max_frontier:
             if not beam:
-                res = CheckResult(CheckOutcome.UNKNOWN)
+                res = CheckResult(
+                    CheckOutcome.UNKNOWN, deepest=deepest_of(deep_counts)
+                )
                 if collect_stats:
                     res.stats = stats  # type: ignore[attr-defined]
                 return res
