@@ -4,4 +4,4 @@ The reference stamps its checker binary from ``golang/VERSION`` (v0.4.0) via
 ldflags (Makefile:5,9); we keep the version in one importable place instead.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
