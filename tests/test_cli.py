@@ -183,3 +183,28 @@ def test_viz_annotates_device_linearization(history_path):
     assert res.ok and res.linearization is not None
     html_text = render_html(full, res, checked=checked)
     assert html_text.count('<span class="ord">') == len(checked.ops)
+
+
+def test_auto_backend_escalates_to_device(tmp_path):
+    # A zero CPU budget (but not -time-budget 0, which means unbounded)
+    # cannot be expressed; instead use a tiny budget on an adversarial
+    # instance the oracle cannot finish instantly, so auto escalates to
+    # the device search and still reaches a conclusive OK.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    path = tmp_path / "adv.jsonl"
+    with open(path, "w") as f:
+        ev.write_history(adversarial_events(6, batch=4, seed=2), f)
+    rc = main(
+        [
+            "check",
+            "-file",
+            str(path),
+            "-backend",
+            "auto",
+            "-time-budget",
+            "0.000001",
+            "-no-viz",
+        ]
+    )
+    assert rc == 0
