@@ -161,7 +161,10 @@ def render_html(
             for s in result.final_states
         )
         pieces.append(f'<div class="final">final states: {states}</div>')
-    elif result.outcome == CheckOutcome.ILLEGAL and result.deepest:
+    elif result.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN) and result.deepest:
+        # Partial-linearization outline, like porcupine.Visualize draws for
+        # failed checks (main.go:608-631) — also for inconclusive runs
+        # (budget or beam exhaustion), which the reference cannot produce.
         pieces.append(
             f'<div class="final">deepest linearized prefix: '
             f"{len(result.deepest)} / "

@@ -1,0 +1,93 @@
+"""Measure the BASELINE.md collector-config table across engines.
+
+Usage: python scripts/table_bench.py [--skip-device] [--seed N]
+
+Runs the five BASELINE.json configs (plus the 5x2000 north-star shape)
+through the Python oracle, the C++ native engine, and the device search
+(warm + steady), and prints a markdown table row per config — the source
+for BASELINE.md's measured table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+
+CONFIGS = [
+    ("regular", 2, 50),
+    ("regular", 5, 100),
+    ("match-seq-num", 5, 200),
+    ("fencing", 8, 500),
+    ("match-seq-num", 5, 2000),
+    ("match-seq-num", 16, 2000),
+]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-device", action="store_true")
+    ap.add_argument("--seed", type=int, default=4242)
+    args = ap.parse_args()
+
+    for workflow, clients, ops in CONFIGS:
+        events = collect_history(
+            CollectConfig(
+                num_concurrent_clients=clients,
+                num_ops_per_client=ops,
+                workflow=workflow,
+                seed=args.seed,
+                faults=FaultPlan(
+                    p_append_definite=0.05,
+                    p_append_indefinite=12.0 / max(clients * ops, 1),
+                    p_read_fail=0.02,
+                    p_check_tail_fail=0.02,
+                ),
+            )
+        )
+        hist = prepare(events)
+
+        from s2_verification_tpu.checker.oracle import check
+
+        t0 = time.monotonic()
+        o = check(hist, time_budget_s=120)
+        o_s = time.monotonic() - t0
+
+        from s2_verification_tpu.checker.native import check_native
+
+        t0 = time.monotonic()
+        nres = check_native(hist, time_budget_s=120)
+        n_s = time.monotonic() - t0
+
+        d_s = w_s = float("nan")
+        doutcome = "-"
+        if not args.skip_device:
+            from s2_verification_tpu.checker.device import check_device_auto
+
+            t0 = time.monotonic()
+            d = check_device_auto(hist)
+            w_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            d = check_device_auto(hist)
+            d_s = time.monotonic() - t0
+            doutcome = d.outcome.name
+            assert d.outcome == o.outcome, (workflow, clients, ops)
+        assert nres.outcome == o.outcome
+        print(
+            f"| {workflow} {clients}x{ops} | {len(hist.ops)} | {o_s:.3f} s | "
+            f"{n_s:.3f} s | {d_s:.2f} s (warm {w_s:.2f}) | "
+            f"{o.outcome.name}/{doutcome} |",
+            flush=True,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

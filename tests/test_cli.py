@@ -208,3 +208,19 @@ def test_auto_backend_escalates_to_device(tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_viz_outlines_deepest_on_unknown(tmp_path):
+    # An inconclusive run (oracle budget exhausted) still draws the deepest
+    # partial linearization, like the failed-check outline.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    events = adversarial_events(9, batch=4, seed=3)
+    checked = prepare(events)
+    # Enough budget to commit thousands of steps, far too little to decide
+    # the ~10^6-config instance.
+    res = check(checked, time_budget_s=0.05)
+    assert res.outcome.name == "UNKNOWN"
+    assert res.deepest
+    html_text = render_html(prepare(events, elide_trivial=False), res, checked=checked)
+    assert "deepest linearized prefix" in html_text
