@@ -12,10 +12,11 @@ north-star target time (BASELINE.json: <10 s) over the measured time; ≥1.0
 means the target is met.
 
 A SECOND JSON line goes to stderr: the adversarial north-star regime —
-the k-way ambiguous-append history family (collector/adversarial.py) at a
-k where the native C++ Wing–Gong engine cannot finish inside 30 minutes
-(measured curve in BASELINE.md; the in-run native probe reports DNF within
-its short budget).  Its ``vs_baseline`` is the native engine's wall-clock
+the k-way ambiguous-append history family (collector/adversarial.py) at
+the largest k whose exhaustive frontier fits one chip (default k=10, peak
+~411k rows; k=12 — where the native C++ engine crosses the 30-minute wall,
+BASELINE.md — needs the north star's 8-chip slice, whose aggregate HBM the
+sharded frontier spans).  Its ``vs_baseline`` is the native engine's wall-clock
 on the same instance — the live probe time when it finished, else the
 measured batch=100 curve, capped at 1800 s (the 30-minute wall, which
 k>=12 exceeds) — over the device's conclusive wall-clock: the "verify on
@@ -70,6 +71,33 @@ def _zero_line(note: str) -> int:
         flush=True,
     )
     return 1
+
+
+def make_bench_history(workflow: str, clients: int, ops: int, seed: int):
+    """The benchmark's collector-history distribution, shared with
+    scripts/table_bench.py so BASELINE.md's table and the headline metric
+    always measure the same instances.
+
+    Fault rates are tuned to the reference's client-id budget
+    (MAX_CLIENT_IDS=20, history.rs:32): every indefinite append burns one
+    rotation, so the rate must leave the full op count collectable while
+    still parking ~a dozen open ambiguous appends.
+    """
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=clients,
+            num_ops_per_client=ops,
+            workflow=workflow,
+            seed=seed,
+            faults=FaultPlan(
+                p_append_definite=0.05,
+                p_append_indefinite=12.0 / max(clients * ops, 1),
+                p_read_fail=0.02,
+                p_check_tail_fail=0.02,
+            ),
+        )
+    )
+    return prepare(events)
 
 
 def north_star() -> int:
@@ -128,25 +156,7 @@ def north_star() -> int:
     seed = int(os.environ.get("S2VTPU_BENCH_SEED", "20260729"))
     oracle_budget = float(os.environ.get("S2VTPU_BENCH_ORACLE_BUDGET_S", "60"))
 
-    # Fault rates are tuned to the reference's client-id budget
-    # (MAX_CLIENT_IDS=20, history.rs:32): every indefinite append burns one
-    # rotation, so the rate must leave the full op count collectable while
-    # still parking ~a dozen open ambiguous appends.
-    events = collect_history(
-        CollectConfig(
-            num_concurrent_clients=clients,
-            num_ops_per_client=ops,
-            workflow="match-seq-num",
-            seed=seed,
-            faults=FaultPlan(
-                p_append_definite=0.05,
-                p_append_indefinite=12.0 / max(clients * ops, 1),
-                p_read_fail=0.02,
-                p_check_tail_fail=0.02,
-            ),
-        )
-    )
-    hist = prepare(events)
+    hist = make_bench_history("match-seq-num", clients, ops, seed)
     n_ops = len(hist.ops)
     print(f"# history: {clients}x{ops} match-seq-num, {n_ops} checked ops", file=sys.stderr)
 
@@ -208,7 +218,7 @@ def adversarial_line() -> None:
         ordered_subsets_count,
     )
 
-    k0 = int(os.environ.get("S2VTPU_BENCH_ADV_K", "12"))
+    k0 = int(os.environ.get("S2VTPU_BENCH_ADV_K", "10"))
     batch = int(os.environ.get("S2VTPU_BENCH_ADV_BATCH", "100"))
     native_budget = float(os.environ.get("S2VTPU_BENCH_ADV_NATIVE_BUDGET_S", "60"))
     kw = dict(max_frontier=1 << 21, start_frontier=1 << 14, beam=False, witness=False)

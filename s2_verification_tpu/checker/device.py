@@ -429,6 +429,10 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     idx = idx2
     keep_u = jnp.zeros(e2, bool)
     surv = valid2
+    # Loop-invariant pieces of the exact compare, hoisted out of the
+    # probe rounds (only the winner side depends on the round).
+    ar = lax.iota(_I32, c)[None, :]
+    cc_i = frontier.counts[parent2] + (chain2[:, None] == ar).astype(_I32)
     for r in range(3):
         slot = (hh1 + _U32(r) * (hh2 | _U32(1))) & _U32(tsz - 1)
         tbl = jnp.full(tsz, e2, _I32).at[slot].min(
@@ -437,19 +441,20 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
         win = tbl[slot]
         w = jnp.minimum(win, e2 - 1)
         is_win = surv & (win == idx)
-        # Counts equality is tested as same-chain + equal parent counts —
-        # never materializing the [e2, C] child-counts matrix (the largest
-        # buffer of the old layer; it capped the frontier well below HBM).
-        # A cross-chain coincidence (different chains stepping different
-        # parents to identical child counts) is not merged; missed merges
-        # only cost capacity, never soundness.
+        # Exact child-counts equality as a fused gather-compare-reduce —
+        # no materialized [e2, C] child-counts matrix (the old layer's
+        # largest buffer).  Full equality — NOT a same-chain shortcut — is
+        # load-bearing: the adversarial family's dedup merges are exactly
+        # the cross-chain A-then-B vs B-then-A reorderings, and requiring
+        # equal last chains blew the k=10 frontier up 10x (sequences
+        # instead of sets).
+        cc_w = frontier.counts[parent2[w]] + (chain2[w][:, None] == ar).astype(_I32)
         eq = (
             (t2 == t2[w])
             & (h2 == h2[w])
             & (l2 == l2[w])
             & (k2 == k2[w])
-            & (chain2 == chain2[w])
-            & (frontier.counts[parent2] == frontier.counts[parent2[w]]).all(axis=1)
+            & (cc_i == cc_w).all(axis=1)
         )
         dup = surv & ~is_win & eq
         keep_u = keep_u | is_win

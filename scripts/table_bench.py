@@ -17,9 +17,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from s2_verification_tpu.checker.entries import prepare
-from s2_verification_tpu.collector.collect import CollectConfig, collect_history
-from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from bench import make_bench_history
 
 CONFIGS = [
     ("regular", 2, 50),
@@ -38,21 +36,7 @@ def main() -> int:
     args = ap.parse_args()
 
     for workflow, clients, ops in CONFIGS:
-        events = collect_history(
-            CollectConfig(
-                num_concurrent_clients=clients,
-                num_ops_per_client=ops,
-                workflow=workflow,
-                seed=args.seed,
-                faults=FaultPlan(
-                    p_append_definite=0.05,
-                    p_append_indefinite=12.0 / max(clients * ops, 1),
-                    p_read_fail=0.02,
-                    p_check_tail_fail=0.02,
-                ),
-            )
-        )
-        hist = prepare(events)
+        hist = make_bench_history(workflow, clients, ops, args.seed)
 
         from s2_verification_tpu.checker.oracle import check
 
@@ -78,8 +62,14 @@ def main() -> int:
             d = check_device_auto(hist)
             d_s = time.monotonic() - t0
             doutcome = d.outcome.name
-            assert d.outcome == o.outcome, (workflow, clients, ops)
-        assert nres.outcome == o.outcome
+            # A budget-limited engine may say UNKNOWN where another is
+            # conclusive (the CPU-intractable configs are the point of the
+            # table); only conclusive disagreements are errors.
+            conclusive = {"OK", "ILLEGAL"}
+            if d.outcome.name in conclusive and o.outcome.name in conclusive:
+                assert d.outcome == o.outcome, (workflow, clients, ops)
+        if nres.outcome.name in {"OK", "ILLEGAL"} and o.outcome.name in {"OK", "ILLEGAL"}:
+            assert nres.outcome == o.outcome
         print(
             f"| {workflow} {clients}x{ops} | {len(hist.ops)} | {o_s:.3f} s | "
             f"{n_s:.3f} s | {d_s:.2f} s (warm {w_s:.2f}) | "
