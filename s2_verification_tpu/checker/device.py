@@ -724,6 +724,8 @@ def check_device(
     checkpoint_every: int = 512,
     witness: bool = True,
     witness_max_frontier: int = 4096,
+    spill: bool = False,
+    spill_host_cap: int = 1 << 26,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -753,6 +755,15 @@ def check_device(
     escalates past ``witness_max_frontier`` (the log costs O(layers x F)
     device memory) or when resuming from a checkpoint (earlier layers'
     logs are gone).
+
+    ``spill=True`` (exhaustive mode only): when the frontier outgrows
+    ``max_frontier``, spill it to host RAM and stream slabs through the
+    chip — layer by layer, each slab one compiled single-layer pass, with
+    exact host-side dedup between layers — instead of conceding UNKNOWN.
+    Out-of-core exhaustion stays conclusive (nothing is ever dropped) up
+    to ``spill_host_cap`` host rows; the witness log does not survive the
+    spill, so OK verdicts carry no linearization.  A capability past the
+    reference, whose search is bounded by one process's memory.
     """
     del state_slots
     enc = encode_history(history)
@@ -787,6 +798,40 @@ def check_device(
         )
 
         fingerprint = history_fingerprint(enc)
+        spill_snapshot = f"{checkpoint_path}.spill.npz"
+        if os.path.exists(spill_snapshot):
+            data = np.load(spill_snapshot, allow_pickle=False)
+            if str(data["fingerprint"]) != fingerprint:
+                raise CheckpointError(
+                    f"spill checkpoint {spill_snapshot} belongs to a "
+                    "different history (fingerprint mismatch)"
+                )
+            if beam or not spill:
+                raise CheckpointError(
+                    f"spill checkpoint {spill_snapshot} requires an "
+                    "exhaustive spill-enabled run to resume"
+                )
+            stats.layers = int(data["layers"])
+            deep0 = np.asarray(data["deep"])
+            res = _spill_search(
+                enc,
+                tables,
+                np.asarray(data["host"]),
+                stats,
+                _floor_pow2(max_frontier, 2),
+                int(enc.total_remaining) + 2,
+                mesh=mesh,
+                host_cap=spill_host_cap,
+                deep_counts=deep0 if len(deep0) else None,
+                checkpoint_path=checkpoint_path,
+                fingerprint=fingerprint,
+            )
+            if res.outcome != CheckOutcome.UNKNOWN:
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(checkpoint_path)
+            if collect_stats:
+                res.stats = stats  # type: ignore[attr-defined]
+            return res
         if os.path.exists(checkpoint_path):
             ck = load_checkpoint(checkpoint_path)
             if ck.fingerprint != fingerprint:
@@ -940,6 +985,21 @@ def check_device(
                 f = min(need, f_cap)
                 log.debug("capacity stop: escalating frontier to %d and resuming", f)
                 resume = _regrow(resume, f)
+            elif not beam and spill:
+                res = _spill_search(
+                    enc,
+                    tables,
+                    resume,
+                    stats,
+                    f_cap,
+                    cap_layers,
+                    mesh=mesh,
+                    host_cap=spill_host_cap,
+                    deep_counts=deep_counts,
+                    checkpoint_path=checkpoint_path,
+                    fingerprint=fingerprint if checkpoint_path else None,
+                )
+                break
             else:
                 stats.pruned = True
                 res = CheckResult(CheckOutcome.UNKNOWN)
@@ -1107,6 +1167,177 @@ def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
     return out
 
 
+def _spill_search(
+    enc: EncodedHistory,
+    tables: SearchTables,
+    seed: "Frontier | np.ndarray",
+    stats: FrontierStats,
+    f_cap: int,
+    cap_layers: int,
+    *,
+    mesh,
+    host_cap: int,
+    deep_counts,
+    checkpoint_path: str | None = None,
+    fingerprint: str | None = None,
+) -> CheckResult:
+    """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
+
+    Each layer streams the host frontier through ``run_search(max_layers=1)``
+    in slabs of a device bucket (``f_cap``, raised to at least ``4*C`` so a
+    single row's children always fit): auto-close, accept check, one
+    expansion, and in-slab dedup all run compiled; exact cross-slab dedup
+    happens host-side (``np.unique``) between layers.  Nothing is ever
+    pruned, so OK and ILLEGAL both stay conclusive; UNKNOWN only when the
+    host frontier exceeds ``host_cap`` rows (checked inside the slab loop
+    too — transient children are bounded, not just the post-dedup set).
+    The slab fill resets each layer and halves within a layer on a growth
+    spike.  On OK the reported ``final_states`` are the accepting *slab's*
+    set — a slab-local (possibly partial) view of the accept
+    configuration's candidate states; the reference exposes no final
+    states at all, so a partial set is still information beyond parity.
+    With ``checkpoint_path``, the host frontier is snapshotted at each
+    layer boundary (``<path>.spill.npz``) and a matching snapshot is
+    resumed from.
+    """
+    c = enc.num_chains
+    # A bucket that always fits one row's <= 2C children, whatever the
+    # caller's max_frontier was.
+    f_cap = max(f_cap, _round_pow2(4 * max(c, 1), 2))
+    spill_ck = f"{checkpoint_path}.spill.npz" if checkpoint_path else None
+
+    def to_host(fr: Frontier) -> np.ndarray:
+        valid = np.asarray(fr.valid)
+        rows = np.flatnonzero(valid)
+        mat = np.empty((len(rows), c + 4), np.int32)
+        mat[:, :c] = np.asarray(fr.counts)[rows]
+        mat[:, c] = np.asarray(fr.tail).view(np.int32)[rows]
+        mat[:, c + 1] = np.asarray(fr.hi).view(np.int32)[rows]
+        mat[:, c + 2] = np.asarray(fr.lo).view(np.int32)[rows]
+        mat[:, c + 3] = np.asarray(fr.tok)[rows]
+        return mat
+
+    def to_device(mat: np.ndarray) -> Frontier:
+        n = mat.shape[0]
+        counts = np.zeros((f_cap, c), np.int32)
+        counts[:n] = mat[:, :c]
+        one = lambda col, dt: np.concatenate(
+            [mat[:, col].astype(np.int32).view(dt), np.zeros(f_cap - n, dt)]
+        )
+        valid = np.zeros(f_cap, bool)
+        valid[:n] = True
+        fr = Frontier(
+            counts=jnp.asarray(counts),
+            tail=jnp.asarray(one(c, np.uint32)),
+            hi=jnp.asarray(one(c + 1, np.uint32)),
+            lo=jnp.asarray(one(c + 2, np.uint32)),
+            tok=jnp.asarray(one(c + 3, np.int32)),
+            valid=jnp.asarray(valid),
+        )
+        return place_frontier(fr, mesh) if mesh is not None else fr
+
+    def unknown() -> CheckResult:
+        stats.pruned = True
+        return CheckResult(CheckOutcome.UNKNOWN)
+
+    host = seed if isinstance(seed, np.ndarray) else to_host(seed)
+    deep = np.asarray(deep_counts) if deep_counts is not None else None
+    deep_sum = int(deep.sum()) if deep is not None else -1
+    log.debug(
+        "spilling to host: %d rows, device bucket %d", len(host), f_cap
+    )
+
+    while stats.layers < cap_layers:
+        if spill_ck is not None:
+            tmp = spill_ck + ".tmp.npz"
+            np.savez_compressed(
+                tmp,
+                fingerprint=np.array(fingerprint or ""),
+                host=host,
+                layers=np.int64(stats.layers),
+                deep=deep if deep is not None else np.zeros(0, np.int32),
+            )
+            os.replace(tmp, spill_ck)
+        children: list[np.ndarray] = []
+        children_rows = 0
+        slab = max(1, f_cap // 4)
+        i = 0
+        while i < len(host):
+            take = min(slab, len(host) - i)
+            out = jax.device_get(
+                run_search(
+                    tables,
+                    to_device(host[i : i + take]),
+                    np.int32(1),
+                    allow_prune=False,
+                )
+            )
+            code = int(out.stop_code)
+            if code == STOP_CAPACITY:
+                if slab == 1:
+                    # Unreachable: f_cap >= 4C fits one row's children.
+                    return unknown()
+                slab = max(1, slab // 2)
+                log.debug("slab overflow: halving fill to %d", slab)
+                continue
+            stats.auto_closed += int(out.auto_closed)
+            stats.expanded += int(out.expanded)
+            if code == STOP_ACCEPT:
+                stats.layers += 1
+                res = CheckResult(
+                    CheckOutcome.OK,
+                    linearization=None,
+                    final_states=_final_states(
+                        enc, Frontier(*(np.asarray(x) for x in out.frontier)),
+                        int(out.accept_idx),
+                    ),
+                )
+                if spill_ck is not None:
+                    with contextlib.suppress(FileNotFoundError):
+                        os.remove(spill_ck)
+                return res
+            dc = np.asarray(out.deep_counts)
+            if int(dc.sum()) > deep_sum:
+                deep_sum, deep = int(dc.sum()), dc
+            if code != STOP_EMPTY:
+                ch = to_host(out.frontier)
+                children.append(ch)
+                children_rows += len(ch)
+                if children_rows > 2 * host_cap:
+                    # Bound transient host memory, not just the post-dedup
+                    # set: a layer's raw children can exceed the cap
+                    # many-fold before np.unique runs.
+                    log.warning(
+                        "spill children %d exceed 2x spill_host_cap %d; UNKNOWN",
+                        children_rows,
+                        host_cap,
+                    )
+                    return unknown()
+            i += take
+        stats.layers += 1
+        if not children:
+            res = CheckResult(
+                CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+            )
+            if spill_ck is not None:
+                with contextlib.suppress(FileNotFoundError):
+                    os.remove(spill_ck)
+            return res
+        host = np.unique(np.concatenate(children), axis=0)
+        stats.max_frontier = max(stats.max_frontier, len(host))
+        log.debug(
+            "spill layer %d: %d host rows", stats.layers, len(host)
+        )
+        if len(host) > host_cap:
+            log.warning(
+                "host frontier %d exceeds spill_host_cap %d; UNKNOWN",
+                len(host),
+                host_cap,
+            )
+            return unknown()
+    return unknown()
+
+
 def _regrow(fr: Frontier, capacity: int) -> Frontier:
     """Re-pad a frontier into a larger capacity bucket."""
     f0, c = np.asarray(fr.counts).shape
@@ -1141,6 +1372,8 @@ def check_device_auto(
     checkpoint_every: int = 512,
     witness: bool = True,
     witness_max_frontier: int = 4096,
+    spill: bool = True,
+    spill_host_cap: int = 1 << 26,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
@@ -1205,6 +1438,8 @@ def check_device_auto(
         checkpoint_every=checkpoint_every,
         witness=witness,
         witness_max_frontier=witness_max_frontier,
+        spill=spill,
+        spill_host_cap=spill_host_cap,
     )
     # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
     # paired with the kept exhaustive snapshot: a retry (e.g. with a larger

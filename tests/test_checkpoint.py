@@ -191,3 +191,33 @@ def test_mismatched_history_rejected(hist, tmp_path):
     )
     with pytest.raises(ValueError, match="fingerprint"):
         check_device(hist, beam=False, checkpoint_path=ck)
+
+
+def test_spill_checkpoint_resume(tmp_path):
+    # Out-of-core phase snapshots the host frontier each layer; an UNKNOWN
+    # (host cap) leaves the snapshot, and a rerun with a bigger cap resumes
+    # from it instead of replaying, reaching the same conclusive verdict.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    ck = str(tmp_path / "spill.ck")
+
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        spill_host_cap=64, checkpoint_path=ck,
+    )
+    assert res.outcome == CheckOutcome.UNKNOWN
+    assert os.path.exists(ck + ".spill.npz")
+
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        spill_host_cap=1 << 20, checkpoint_path=ck, collect_stats=True,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert not os.path.exists(ck + ".spill.npz")
+
+    # The resumed verdict matches a from-scratch run.
+    fresh = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True
+    )
+    assert fresh.outcome == CheckOutcome.OK

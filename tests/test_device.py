@@ -261,3 +261,70 @@ def test_device_witness_dropped_beyond_cap():
     )
     assert res.outcome == CheckOutcome.OK
     assert res.linearization is None
+
+
+def test_spill_matches_oracle_on_random_histories():
+    # Out-of-core mode: a tiny device bucket forces the frontier to spill
+    # to host RAM and stream slabs; verdicts must still match the DFS and
+    # stay conclusive (nothing is pruned).
+    # (random_history instances are 1-4 ops, so most stay in-core; the
+    # engagement proof lives in test_spill_adversarial_conclusive.)
+    rng = random.Random(0x5B1)
+    for trial in range(30):
+        h = random_history(rng)
+        hist = prepare(h.events)
+        want = check(hist)
+        got = check_device(
+            hist, max_frontier=4, start_frontier=4, beam=False, spill=True,
+        )
+        assert got.outcome == want.outcome, f"trial {trial}"
+
+    # A collected history through a bucket far below its frontier peak:
+    # the whole mid-game runs out-of-core and must still accept.
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=12,
+            workflow="match-seq-num",
+            seed=31,
+            faults=FaultPlan.chaos(0.3),
+        )
+    )
+    hist = prepare(events)
+    want = check(hist)
+    got = check_device(
+        hist, max_frontier=8, start_frontier=8, beam=False, spill=True
+    )
+    assert got.outcome == want.outcome
+
+
+def test_spill_adversarial_conclusive():
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    # OK instance: spill must find the accept.
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        collect_stats=True,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.max_frontier > 32  # genuinely out-of-core
+
+    # Unsatisfiable instance: ILLEGAL by exhaustion, through the spill.
+    hist = prepare(adversarial_events(5, batch=4, seed=2, unsatisfiable=True))
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True
+    )
+    assert res.outcome == CheckOutcome.ILLEGAL
+    assert res.deepest  # diagnostics survive the spill
+
+
+def test_spill_host_cap_gives_unknown():
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(7, batch=4, seed=3))
+    res = check_device(
+        hist, max_frontier=16, start_frontier=16, beam=False, spill=True,
+        spill_host_cap=64,
+    )
+    assert res.outcome == CheckOutcome.UNKNOWN
