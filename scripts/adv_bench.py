@@ -41,6 +41,7 @@ def main() -> int:
     ap.add_argument("--frontier", type=int, default=1 << 21)
     ap.add_argument("--start-frontier", type=int, default=1 << 12)
     ap.add_argument("--beam", action="store_true", help="beam instead of exhaustive")
+    ap.add_argument("--spill", action="store_true", help="out-of-core past the frontier cap")
     ap.add_argument("--once", action="store_true", help="skip the steady-state rerun")
     args = ap.parse_args()
 
@@ -88,6 +89,7 @@ def main() -> int:
                 start_frontier=args.start_frontier,
                 collect_stats=True,
                 witness=False,
+                spill=args.spill,
             )
             warm = time.monotonic() - t0
             steady = warm
@@ -100,6 +102,7 @@ def main() -> int:
                     start_frontier=args.start_frontier,
                     collect_stats=True,
                     witness=False,
+                    spill=args.spill,
                 )
                 steady = time.monotonic() - t0
             st = r.stats
