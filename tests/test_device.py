@@ -328,3 +328,20 @@ def test_spill_host_cap_gives_unknown():
         spill_host_cap=64,
     )
     assert res.outcome == CheckOutcome.UNKNOWN
+
+
+def test_spill_sharded_over_mesh():
+    # Out-of-core slabs placed on a sharded mesh: verdict must match.
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("fr",))
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        mesh=mesh, collect_stats=True,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.max_frontier > 32
