@@ -1,0 +1,5 @@
+"""Multi-chip / multi-host parallelism helpers."""
+
+from .distributed import frontier_mesh, init_distributed
+
+__all__ = ["init_distributed", "frontier_mesh"]

@@ -1,0 +1,79 @@
+"""The distributed communication backend: XLA collectives over ICI/DCN.
+
+The reference has no communication backend at all — no NCCL/MPI/Gloo
+anywhere in its tree (SURVEY.md §2.2, §5); its only parallelism is
+goroutines inside one process.  This framework's scale-out axis is the
+search frontier, and the backend is JAX's distributed runtime: every
+per-row computation in the device engine is elementwise over the frontier
+axis, so sharding it over a :class:`jax.sharding.Mesh` makes XLA insert
+the collectives — over ICI within a slice, over DCN across hosts — the
+same way NCCL/MPI backends carry tensor shards elsewhere.
+
+Single-host multi-chip needs no setup: build a mesh over ``jax.devices()``
+and :func:`~..checker.device.place_frontier` the frontier (the driver's
+``mesh=`` argument; ``__graft_entry__.dryrun_multichip`` exercises it).
+Multi-HOST runs additionally need every process to join the distributed
+runtime first — that is :func:`init_distributed`.  After it returns,
+``jax.devices()`` is the *global* device list and a mesh over it spans
+hosts; each process executes the same program SPMD and cross-host
+collectives ride DCN (Gloo on CPU, ICI/DCN on TPU slices).
+
+The search drivers remain single-controller: ``check_device`` materializes
+whole frontiers on the host (escalation, checkpointing, spilling), which
+is a per-process view.  Multi-host deployments therefore run the compiled
+search loop (``run_search``) SPMD and fetch only replicated outputs
+(verdict scalars) — see ``tests/test_distributed.py`` for the two-process
+pattern.
+"""
+
+from __future__ import annotations
+
+__all__ = ["init_distributed", "frontier_mesh"]
+
+
+def init_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    *,
+    local_device_count: int | None = None,
+) -> None:
+    """Join this process to the JAX distributed runtime.
+
+    ``coordinator_address`` is ``host:port`` of process 0.  Call before
+    first device use in every participating process; afterwards
+    ``jax.devices()`` lists every device of every process.
+
+    ``local_device_count`` optionally forces a virtual CPU device count
+    (useful for tests / CPU rehearsals of a multi-host topology); it must
+    be set identically in every process and before jax initializes.
+    """
+    import os
+
+    if local_device_count is not None:
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={local_device_count}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def frontier_mesh(axis: str = "fr"):
+    """A 1-D mesh over every (global) device, named for the frontier axis."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(jax.devices()), (axis,))

@@ -337,8 +337,10 @@ def test_spill_sharded_over_mesh():
 
     from s2_verification_tpu.collector.adversarial import adversarial_events
 
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provision the virtual mesh"
     hist = prepare(adversarial_events(6, batch=4, seed=1))
-    mesh = Mesh(np.asarray(jax.devices()[:8]), ("fr",))
+    mesh = Mesh(np.asarray(devices[:8]), ("fr",))
     res = check_device(
         hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
         mesh=mesh, collect_stats=True,
