@@ -708,6 +708,58 @@ def _final_states(
     return sorted(out)
 
 
+@jax.jit
+def _accept_set_device(fr: Frontier, idx):
+    """Compact the accept configuration's candidate-state set into the
+    frontier's leading rows, on device — so the host fetches only the
+    (small) set itself, never the whole frontier."""
+    same = fr.valid & (fr.counts == fr.counts[idx]).all(axis=1)
+    f = fr.valid.shape[0]
+    pos = jnp.cumsum(same.astype(_I32)) - 1
+    dst = jnp.where(same, pos, f)
+    g = lambda x: jnp.zeros(f, x.dtype).at[dst].set(x, mode="drop")
+    return g(fr.tail), g(fr.hi), g(fr.lo), g(fr.tok), same.sum()
+
+
+def _final_states_device(
+    enc: EncodedHistory, frontier: Frontier, idx: int
+) -> list[StreamState]:
+    """Device-resident twin of :func:`_final_states`: compacts on device and
+    transfers just the accept set (host↔device traffic is the scarce
+    resource — see check_device)."""
+    tails, his, los, toks, m = _accept_set_device(frontier, np.int32(idx))
+    m = int(m)
+    tails, his, los, toks = (
+        np.asarray(x[:m]) for x in (tails, his, los, toks)
+    )
+    out = {
+        StreamState(
+            tail=int(tails[i]),
+            stream_hash=(int(his[i]) << 32) | int(los[i]),
+            fencing_token=enc.token_of_id[int(toks[i])],
+        )
+        for i in range(m)
+    }
+    return sorted(out)
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def _regrow_device(fr: Frontier, *, capacity: int) -> Frontier:
+    """Re-pad a frontier into a larger capacity bucket without leaving the
+    device (escalation must not round-trip the frontier through the host)."""
+    f0, c = fr.counts.shape
+    pad = capacity - f0
+    g1 = lambda x: jnp.concatenate([x, jnp.zeros(pad, x.dtype)])
+    return Frontier(
+        counts=jnp.concatenate([fr.counts, jnp.zeros((pad, c), _I32)]),
+        tail=g1(fr.tail),
+        hi=g1(fr.hi),
+        lo=g1(fr.lo),
+        tok=g1(fr.tok),
+        valid=g1(fr.valid),
+    )
+
+
 _WITNESS_CHUNK = 512
 
 
@@ -882,13 +934,6 @@ def check_device(
                 ),
             )
 
-    def _requeue(fr_np: Frontier, *, snapshot: bool) -> Frontier:
-        """Snapshot a host-side frontier and hand it back to the device."""
-        if snapshot and checkpoint_path is not None:
-            _snapshot(fr_np)
-        dev_fr = jax.tree.map(jnp.asarray, fr_np)
-        return place_frontier(dev_fr, mesh) if mesh is not None else dev_fr
-
     if frontier is None:
         frontier = init_frontier(enc, f)
     if mesh is not None:
@@ -919,53 +964,87 @@ def check_device(
             layers_budget = min(layers_budget, checkpoint_every)
         if witness:
             layers_budget = min(layers_budget, _WITNESS_CHUNK)
-        out = jax.device_get(
-            run_search(
-                tables,
-                frontier,
-                np.int32(layers_budget),
-                allow_prune=allow_prune,
-                log_layers=_WITNESS_CHUNK if witness else 0,
+        out = run_search(
+            tables,
+            frontier,
+            np.int32(layers_budget),
+            allow_prune=allow_prune,
+            log_layers=_WITNESS_CHUNK if witness else 0,
+        )
+        # Scalar-only fetch: the frontier itself stays on device.  Pulling
+        # the whole frontier back per segment (the previous design) moved
+        # ~70MB/segment at k=10 scale and dominated wall-clock many-fold
+        # over the compiled layers themselves; everything the driver needs
+        # to steer is a handful of scalars plus the [C] deep-counts row.
+        (
+            code,
+            seg_layers,
+            seg_max_live,
+            seg_auto_closed,
+            seg_expanded,
+            seg_pruned,
+            want,
+            accept_idx,
+            deep_np,
+            live,
+        ) = jax.device_get(
+            (
+                out.stop_code,
+                out.layers,
+                out.max_live,
+                out.auto_closed,
+                out.expanded,
+                out.pruned_ever,
+                out.want,
+                out.accept_idx,
+                out.deep_counts,
+                out.frontier.valid.sum(),
             )
         )
+        code = int(code)
         log.debug(
             "segment done: stop=%s layers=%d/%d live=%d auto_closed=%d expanded=%d",
-            ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[int(out.stop_code)],
-            stats.layers + int(out.layers),
+            ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
+            stats.layers + int(seg_layers),
             cap_layers,
-            int(out.frontier.valid.sum()),
-            stats.auto_closed + int(out.auto_closed),
-            stats.expanded + int(out.expanded),
+            int(live),
+            stats.auto_closed + int(seg_auto_closed),
+            stats.expanded + int(seg_expanded),
         )
-        stats.layers += int(out.layers)
-        stats.max_frontier = max(stats.max_frontier, int(out.max_live))
+        stats.layers += int(seg_layers)
+        stats.max_frontier = max(stats.max_frontier, int(seg_max_live))
         # max_state_set stays 0: frontier rows are single states, so the
         # candidate-set-width statistic is meaningful only for host engines.
-        stats.auto_closed += int(out.auto_closed)
-        stats.expanded += int(out.expanded)
-        deep_counts = np.asarray(out.deep_counts)
+        stats.auto_closed += int(seg_auto_closed)
+        stats.expanded += int(seg_expanded)
+        deep_counts = np.asarray(deep_np)
         if allow_prune:
-            stats.pruned = stats.pruned or bool(out.pruned_ever)
-        code = int(out.stop_code)
+            stats.pruned = stats.pruned or bool(seg_pruned)
         if witness:
             # Committed expansion layers of this segment, sparsified.  The
             # accept layer expands nothing (its log row is all -1) and a
             # capacity-aborted layer is not committed; neither is consumed.
-            n_rows = int(out.layers) - (1 if code == STOP_ACCEPT else 0)
-            wp, wo = np.asarray(out.wparent), np.asarray(out.wop)
-            for l in range(n_rows):
-                rows = np.flatnonzero(wo[l] >= 0)
-                wlogs.append((rows, wp[l][rows], wo[l][rows]))
+            # Only the committed slice of the log is transferred.
+            n_rows = int(seg_layers) - (1 if code == STOP_ACCEPT else 0)
+            if n_rows > 0:
+                wp, wo = jax.device_get(
+                    (out.wparent[:n_rows], out.wop[:n_rows])
+                )
+                for l in range(n_rows):
+                    rows = np.flatnonzero(wo[l] >= 0)
+                    wlogs.append((rows, wp[l][rows], wo[l][rows]))
         if code == STOP_ACCEPT:
             lin = (
-                _witness_linearization(enc, wlogs, int(out.accept_idx))
+                _witness_linearization(enc, wlogs, int(accept_idx))
                 if witness
                 else None
             )
             res = CheckResult(
                 CheckOutcome.OK,
                 linearization=lin,
-                final_states=_final_states(enc, out.frontier, int(out.accept_idx)),
+                final_states=_final_states_device(
+                    enc, out.frontier, int(accept_idx)
+                ),
             )
             break
         if code == STOP_EMPTY:
@@ -975,17 +1054,24 @@ def check_device(
         if code == STOP_CAPACITY:
             # Capacity wall below the cap: escalate and resume from the
             # returned pre-expansion frontier (no information was lost).
-            resume = Frontier(*(np.asarray(x) for x in out.frontier))
             if f < f_cap:
                 # Jump straight to a bucket that fits the aborted layer's
                 # children (x2 headroom) instead of stepping x4 through
                 # intermediate buckets — each distinct capacity is its own
                 # XLA program, so skipped buckets are skipped compiles.
-                need = _round_pow2(max(int(out.want) * 2, f * 4), 2)
+                need = _round_pow2(max(int(want) * 2, f * 4), 2)
                 f = min(need, f_cap)
                 log.debug("capacity stop: escalating frontier to %d and resuming", f)
-                resume = _regrow(resume, f)
-            elif not beam and spill:
+                frontier = _regrow_device(out.frontier, capacity=f)
+                if mesh is not None:
+                    frontier = place_frontier(frontier, mesh)
+                if checkpoint_path is not None:
+                    _snapshot(Frontier(*(np.asarray(x) for x in frontier)))
+                continue
+            if not beam and spill:
+                # Out-of-core hand-off: the one capacity stop that does
+                # move the frontier to the host (that is the point).
+                resume = Frontier(*(np.asarray(x) for x in out.frontier))
                 res = _spill_search(
                     enc,
                     tables,
@@ -1000,17 +1086,16 @@ def check_device(
                     fingerprint=fingerprint if checkpoint_path else None,
                 )
                 break
-            else:
-                stats.pruned = True
-                res = CheckResult(CheckOutcome.UNKNOWN)
-                break
-            frontier = _requeue(resume, snapshot=True)
-            continue
+            stats.pruned = True
+            res = CheckResult(CheckOutcome.UNKNOWN)
+            break
         if code == STOP_RUNNING and stats.layers < cap_layers:
             # Chunk boundary (checkpoint cadence): snapshot and keep going
-            # from the returned post-expansion frontier.
-            nxt = Frontier(*(np.asarray(x) for x in out.frontier))
-            frontier = _requeue(nxt, snapshot=True)
+            # from the returned post-expansion frontier, which never leaves
+            # the device unless a checkpoint file asked for a host copy.
+            frontier = out.frontier
+            if checkpoint_path is not None:
+                _snapshot(Frontier(*(np.asarray(x) for x in frontier)))
             continue
         # Layer cap hit without a verdict: should be impossible (each layer
         # linearizes exactly one op); treat as inconclusive.
@@ -1336,28 +1421,6 @@ def _spill_search(
             )
             return unknown()
     return unknown()
-
-
-def _regrow(fr: Frontier, capacity: int) -> Frontier:
-    """Re-pad a frontier into a larger capacity bucket."""
-    f0, c = np.asarray(fr.counts).shape
-
-    def grow1(x):
-        x = np.asarray(x)
-        out = np.zeros(capacity, x.dtype)
-        out[:f0] = x
-        return out
-
-    return Frontier(
-        counts=np.concatenate(
-            [np.asarray(fr.counts), np.zeros((capacity - f0, c), np.int32)]
-        ),
-        tail=grow1(fr.tail),
-        hi=grow1(fr.hi),
-        lo=grow1(fr.lo),
-        tok=grow1(fr.tok),
-        valid=grow1(fr.valid),
-    )
 
 
 def check_device_auto(
