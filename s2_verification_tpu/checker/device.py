@@ -685,52 +685,27 @@ def _floor_pow2(n: int, lo: int) -> int:
     return v
 
 
-def _final_states(
-    enc: EncodedHistory, frontier: Frontier, idx: int
-) -> list[StreamState]:
-    """States of every valid row sharing the accept row's counts — the
-    accept configuration's candidate-state set."""
-    counts = np.asarray(frontier.counts)
-    valid = np.asarray(frontier.valid)
-    tail = np.asarray(frontier.tail)
-    hi = np.asarray(frontier.hi)
-    lo = np.asarray(frontier.lo)
-    tok = np.asarray(frontier.tok)
-    same = valid & (counts == counts[idx]).all(axis=1)
-    out = {
-        StreamState(
-            tail=int(tail[i]),
-            stream_hash=(int(hi[i]) << 32) | int(lo[i]),
-            fencing_token=enc.token_of_id[int(tok[i])],
-        )
-        for i in np.flatnonzero(same)
-    }
-    return sorted(out)
-
-
 @jax.jit
 def _accept_set_device(fr: Frontier, idx):
     """Compact the accept configuration's candidate-state set into the
     frontier's leading rows, on device — so the host fetches only the
     (small) set itself, never the whole frontier."""
     same = fr.valid & (fr.counts == fr.counts[idx]).all(axis=1)
-    f = fr.valid.shape[0]
-    pos = jnp.cumsum(same.astype(_I32)) - 1
-    dst = jnp.where(same, pos, f)
-    g = lambda x: jnp.zeros(f, x.dtype).at[dst].set(x, mode="drop")
-    return g(fr.tail), g(fr.hi), g(fr.lo), g(fr.tok), same.sum()
+    _, tail, hi, lo, tok, n = _compact_rows_device(fr._replace(valid=same))
+    return tail, hi, lo, tok, n
 
 
 def _final_states_device(
     enc: EncodedHistory, frontier: Frontier, idx: int
 ) -> list[StreamState]:
-    """Device-resident twin of :func:`_final_states`: compacts on device and
-    transfers just the accept set (host↔device traffic is the scarce
+    """States of every valid row sharing the accept row's counts — the
+    accept configuration's candidate-state set.  Compacts on device and
+    transfers just the set itself (host↔device traffic is the scarce
     resource — see check_device)."""
     tails, his, los, toks, m = _accept_set_device(frontier, np.int32(idx))
     m = int(m)
-    tails, his, los, toks = (
-        np.asarray(x[:m]) for x in (tails, his, los, toks)
+    tails, his, los, toks = jax.device_get(
+        (tails[:m], his[:m], los[:m], toks[:m])
     )
     out = {
         StreamState(
@@ -741,6 +716,26 @@ def _final_states_device(
         for i in range(m)
     }
     return sorted(out)
+
+
+@jax.jit
+def _compact_rows_device(fr: Frontier):
+    """Compact valid rows to the frontier's leading slots, on device.
+    Returns ``(counts, tail, hi, lo, tok, n_valid)`` so callers can fetch
+    exactly the live rows and nothing else."""
+    f = fr.valid.shape[0]
+    pos = jnp.cumsum(fr.valid.astype(_I32)) - 1
+    dst = jnp.where(fr.valid, pos, f)
+    counts = jnp.zeros_like(fr.counts).at[dst].set(fr.counts, mode="drop")
+    g1 = lambda x: jnp.zeros(f, x.dtype).at[dst].set(x, mode="drop")
+    return (
+        counts,
+        g1(fr.tail),
+        g1(fr.hi),
+        g1(fr.lo),
+        g1(fr.tok),
+        fr.valid.sum(),
+    )
 
 
 @partial(jax.jit, static_argnames=("capacity",))
@@ -1017,7 +1012,7 @@ def check_device(
         # candidate-set-width statistic is meaningful only for host engines.
         stats.auto_closed += int(seg_auto_closed)
         stats.expanded += int(seg_expanded)
-        deep_counts = np.asarray(deep_np)
+        deep_counts = deep_np
         if allow_prune:
             stats.pruned = stats.pruned or bool(seg_pruned)
         if witness:
@@ -1069,13 +1064,13 @@ def check_device(
                     _snapshot(Frontier(*(np.asarray(x) for x in frontier)))
                 continue
             if not beam and spill:
-                # Out-of-core hand-off: the one capacity stop that does
-                # move the frontier to the host (that is the point).
-                resume = Frontier(*(np.asarray(x) for x in out.frontier))
+                # Out-of-core hand-off: the frontier goes to the host here
+                # (that is the point), but compacted on device first —
+                # _spill_search's to_host fetches only the live rows.
                 res = _spill_search(
                     enc,
                     tables,
-                    resume,
+                    out.frontier,
                     stats,
                     f_cap,
                     cap_layers,
@@ -1292,24 +1287,32 @@ def _spill_search(
     spill_ck = f"{checkpoint_path}.spill.npz" if checkpoint_path else None
 
     def to_host(fr: Frontier) -> np.ndarray:
-        valid = np.asarray(fr.valid)
-        rows = np.flatnonzero(valid)
-        mat = np.empty((len(rows), c + 4), np.int32)
-        mat[:, :c] = np.asarray(fr.counts)[rows]
-        mat[:, c] = np.asarray(fr.tail).view(np.int32)[rows]
-        mat[:, c + 1] = np.asarray(fr.hi).view(np.int32)[rows]
-        mat[:, c + 2] = np.asarray(fr.lo).view(np.int32)[rows]
-        mat[:, c + 3] = np.asarray(fr.tok)[rows]
+        # Compact valid rows to the front on device so only live data
+        # crosses the host boundary (the padded bucket tail never does).
+        counts, tail, hi, lo, tok, n = _compact_rows_device(fr)
+        n = int(n)
+        counts, tail, hi, lo, tok = jax.device_get(
+            (counts[:n], tail[:n], hi[:n], lo[:n], tok[:n])
+        )
+        mat = np.empty((n, c + 4), np.int32)
+        mat[:, :c] = counts
+        mat[:, c] = tail.view(np.int32)
+        mat[:, c + 1] = hi.view(np.int32)
+        mat[:, c + 2] = lo.view(np.int32)
+        mat[:, c + 3] = tok
         return mat
 
     def to_device(mat: np.ndarray) -> Frontier:
+        # Upload only a tight power-of-two bucket around the live rows and
+        # pad to the slab capacity on device.
         n = mat.shape[0]
-        counts = np.zeros((f_cap, c), np.int32)
+        p2 = min(_round_pow2(max(n, 1), 64), f_cap)
+        counts = np.zeros((p2, c), np.int32)
         counts[:n] = mat[:, :c]
         one = lambda col, dt: np.concatenate(
-            [mat[:, col].astype(np.int32).view(dt), np.zeros(f_cap - n, dt)]
+            [mat[:, col].astype(np.int32).view(dt), np.zeros(p2 - n, dt)]
         )
-        valid = np.zeros(f_cap, bool)
+        valid = np.zeros(p2, bool)
         valid[:n] = True
         fr = Frontier(
             counts=jnp.asarray(counts),
@@ -1319,6 +1322,8 @@ def _spill_search(
             tok=jnp.asarray(one(c + 3, np.int32)),
             valid=jnp.asarray(valid),
         )
+        if p2 < f_cap:
+            fr = _regrow_device(fr, capacity=f_cap)
         return place_frontier(fr, mesh) if mesh is not None else fr
 
     def unknown() -> CheckResult:
@@ -1349,15 +1354,23 @@ def _spill_search(
         i = 0
         while i < len(host):
             take = min(slab, len(host) - i)
-            out = jax.device_get(
-                run_search(
-                    tables,
-                    to_device(host[i : i + take]),
-                    np.int32(1),
-                    allow_prune=False,
+            out = run_search(
+                tables,
+                to_device(host[i : i + take]),
+                np.int32(1),
+                allow_prune=False,
+            )
+            # Scalar-only fetch; children cross back compacted (to_host).
+            code, seg_ac, seg_ex, accept_idx, dc = jax.device_get(
+                (
+                    out.stop_code,
+                    out.auto_closed,
+                    out.expanded,
+                    out.accept_idx,
+                    out.deep_counts,
                 )
             )
-            code = int(out.stop_code)
+            code = int(code)
             if code == STOP_CAPACITY:
                 if slab == 1:
                     # Unreachable: f_cap >= 4C fits one row's children.
@@ -1365,23 +1378,21 @@ def _spill_search(
                 slab = max(1, slab // 2)
                 log.debug("slab overflow: halving fill to %d", slab)
                 continue
-            stats.auto_closed += int(out.auto_closed)
-            stats.expanded += int(out.expanded)
+            stats.auto_closed += int(seg_ac)
+            stats.expanded += int(seg_ex)
             if code == STOP_ACCEPT:
                 stats.layers += 1
                 res = CheckResult(
                     CheckOutcome.OK,
                     linearization=None,
-                    final_states=_final_states(
-                        enc, Frontier(*(np.asarray(x) for x in out.frontier)),
-                        int(out.accept_idx),
+                    final_states=_final_states_device(
+                        enc, out.frontier, int(accept_idx)
                     ),
                 )
                 if spill_ck is not None:
                     with contextlib.suppress(FileNotFoundError):
                         os.remove(spill_ck)
                 return res
-            dc = np.asarray(out.deep_counts)
             if int(dc.sum()) > deep_sum:
                 deep_sum, deep = int(dc.sum()), dc
             if code != STOP_EMPTY:
