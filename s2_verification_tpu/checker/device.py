@@ -1247,6 +1247,67 @@ def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
     return out
 
 
+def _dedup_rows(mat: np.ndarray, _key_bits: int = 64) -> np.ndarray:
+    """Exact row dedup for the spill frontier.
+
+    ``np.unique(axis=0)`` lexicographically sorts the full c+4-column rows
+    (it views each row as one big void scalar), which dominates spill-layer
+    time at tens of millions of rows.  One u64-hash argsort gets equal rows
+    adjacent with a single key sort; an exact fixup pass re-checks the rare
+    rows whose hash run still holds more than one distinct row, so the
+    result is exactly ``np.unique``'s row set (order differs; the frontier
+    is a set).  ``_key_bits`` narrows the key in tests to force collisions
+    through the fixup path.
+    """
+    n = len(mat)
+    if n <= 1:
+        return mat
+    u = mat.view(np.uint32)
+    # Two u32 FNV-style lane hashes, folded column-by-column in place (u64
+    # per-column temps doubled the memory traffic and dominated the cost).
+    h1 = np.full(n, 0x811C9DC5, np.uint32)
+    h2 = np.full(n, 0x9747B28C, np.uint32)
+    tmp = np.empty(n, np.uint32)
+    for j in range(mat.shape[1]):
+        col = u[:, j]
+        np.bitwise_xor(h1, col, out=h1)
+        np.multiply(h1, np.uint32(0x01000193), out=h1)
+        np.left_shift(col, np.uint32(1), out=tmp)
+        np.bitwise_or(tmp, np.uint32(1), out=tmp)
+        np.bitwise_xor(h2, tmp, out=h2)
+        np.multiply(h2, np.uint32(0x7FEB352D), out=h2)
+    key = (h1.astype(np.uint64) << np.uint64(32)) | h2
+    if _key_bits < 64:
+        key &= np.uint64((1 << _key_bits) - 1)
+    order = np.argsort(key)  # unstable is fine: the frontier is a set, and
+    # the run-based fixup below is order-independent
+    key_s = key[order]
+    mat_s = mat[order]
+    same_key = np.empty(n, bool)
+    same_key[0] = False
+    same_key[1:] = key_s[1:] == key_s[:-1]
+    dup = np.zeros(n, bool)
+    dup[1:] = same_key[1:] & (mat_s[1:] == mat_s[:-1]).all(axis=1)
+    kept = ~dup
+    # A key run holding >=2 kept rows is either a hash collision or equal
+    # rows a collision separated; re-check those runs with np.unique.  The
+    # whole run goes to the fixup together — equal rows always share a run,
+    # so none can be split between the plain and fixed partitions.  With
+    # 64-bit keys this pass almost never triggers, so probe cheaply first:
+    # a kept row opening neither a new run nor following its run's opener
+    # can only exist under collisions.
+    if not np.count_nonzero(kept & same_key):
+        return mat_s[kept]
+    run_id = np.cumsum(~same_key) - 1
+    kept_per_run = np.bincount(run_id[kept], minlength=int(run_id[-1]) + 1)
+    ambiguous = kept & (kept_per_run[run_id] >= 2)
+    plain = mat_s[kept & ~ambiguous]
+    if not ambiguous.any():
+        return plain
+    fixed = np.unique(mat_s[ambiguous], axis=0)
+    return np.concatenate([plain, fixed])
+
+
 def _spill_search(
     enc: EncodedHistory,
     tables: SearchTables,
@@ -1267,7 +1328,7 @@ def _spill_search(
     in slabs of a device bucket (``f_cap``, raised to at least ``4*C`` so a
     single row's children always fit): auto-close, accept check, one
     expansion, and in-slab dedup all run compiled; exact cross-slab dedup
-    happens host-side (``np.unique``) between layers.  Nothing is ever
+    happens host-side (``_dedup_rows``) between layers.  Nothing is ever
     pruned, so OK and ILLEGAL both stay conclusive; UNKNOWN only when the
     host frontier exceeds ``host_cap`` rows (checked inside the slab loop
     too — transient children are bounded, not just the post-dedup set).
@@ -1419,7 +1480,7 @@ def _spill_search(
                 with contextlib.suppress(FileNotFoundError):
                     os.remove(spill_ck)
             return res
-        host = np.unique(np.concatenate(children), axis=0)
+        host = _dedup_rows(np.concatenate(children))
         stats.max_frontier = max(stats.max_frontier, len(host))
         log.debug(
             "spill layer %d: %d host rows", stats.layers, len(host)
