@@ -32,10 +32,14 @@ the frontier axis vs unsharded, asserting verdict equality and reporting
 relative layer throughput.  On real multi-chip hardware the same flag
 exercises ICI instead of host memory.
 
+When the TPU is unreachable (the axon tunnel hangs on init when down),
+the bench re-runs itself on the XLA:CPU backend and reports that
+measurement with a FALLBACK note instead of a dead zero line.
+
 Env knobs (all optional): S2VTPU_BENCH_CLIENTS, S2VTPU_BENCH_OPS,
 S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S, S2VTPU_BENCH_ADV_K,
 S2VTPU_BENCH_ADV_BATCH, S2VTPU_BENCH_ADV_NATIVE_BUDGET_S,
-S2VTPU_BENCH_SKIP_ADV.
+S2VTPU_BENCH_SKIP_ADV, S2VTPU_BENCH_NO_FALLBACK.
 """
 
 from __future__ import annotations
@@ -71,6 +75,63 @@ def _zero_line(note: str) -> int:
         flush=True,
     )
     return 1
+
+
+def _cpu_child_code(expr: str) -> str:
+    """Re-exec stub for an XLA:CPU child.  The config-API pin is mandatory:
+    the axon sitecustomize hook overrides the JAX_PLATFORMS env var."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return (
+        "import sys\n"
+        f"sys.path.insert(0, {here!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        f"raise SystemExit({expr})\n"
+    )
+
+
+def _cpu_fallback(note: str) -> int:
+    """The TPU is unreachable (the axon tunnel hangs rather than errors when
+    it drops — observed repeatedly): measure the same compiled search on the
+    XLA:CPU backend instead of reporting a dead zero.  The stderr note keeps
+    the headline honest; S2VTPU_BENCH_NO_FALLBACK=1 restores the zero line.
+
+    The child is bounded (the driver must never wedge on a bench), skips the
+    adversarial line by default (that regime is sized for the chip, not host
+    cores — same reasoning as mesh_scaling's CPU shrink), and the parent
+    guarantees the one-JSON-line stdout contract even if the child dies
+    before printing it."""
+    if os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1" or os.environ.get(
+        "S2VTPU_BENCH_NO_FALLBACK"
+    ) == "1":
+        return _zero_line(note)
+    import subprocess
+
+    print(f"# {note}", file=sys.stderr)
+    print("# FALLBACK: XLA:CPU backend (same program, host cores)", file=sys.stderr)
+    env = dict(os.environ)
+    env["S2VTPU_BENCH_CPU_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("S2VTPU_BENCH_SKIP_ADV", "1")
+    timeout_s = float(os.environ.get("S2VTPU_BENCH_FALLBACK_TIMEOUT_S", "1800"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _cpu_child_code("bench.north_star()")],
+            env=env,
+            stdout=subprocess.PIPE,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return _zero_line(f"{note} (CPU fallback timed out >{timeout_s:.0f}s)")
+    outtxt = proc.stdout.decode(errors="replace")
+    if '"metric"' not in outtxt:
+        return _zero_line(
+            f"{note} (CPU fallback rc={proc.returncode}, no metric line)"
+        )
+    sys.stdout.write(outtxt)
+    sys.stdout.flush()
+    return proc.returncode
 
 
 def make_bench_history(workflow: str, clients: int, ops: int, seed: int):
@@ -140,13 +201,13 @@ def north_star() -> int:
 
                 with __import__("contextlib").suppress(ProcessLookupError):
                     os.killpg(child.pid, signal.SIGKILL)
-                return _zero_line(
+                return _cpu_fallback(
                     f"backend init probe hung >{probe_s:.0f}s; TPU tunnel down?"
                 )
             if rc != 0:
                 out.seek(0)
                 err = out.read().decode(errors="replace").strip().splitlines()
-                return _zero_line(
+                return _cpu_fallback(
                     "backend init probe failed: "
                     + (err[-1] if err else f"rc={rc}, no output")
                 )
@@ -378,16 +439,10 @@ def _reexec_mesh(n: int) -> int:
     flags.append(f"--xla_force_host_platform_device_count={n}")
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
-    here = os.path.abspath(__file__)
-    code = (
-        "import sys\n"
-        f"sys.path.insert(0, {os.path.dirname(here)!r})\n"
-        "import jax\n"
-        "jax.config.update('jax_platforms', 'cpu')\n"
-        "import bench\n"
-        f"raise SystemExit(bench.mesh_scaling({n}))\n"
-    )
-    return subprocess.run([sys.executable, "-c", code], env=env).returncode
+    return subprocess.run(
+        [sys.executable, "-c", _cpu_child_code(f"bench.mesh_scaling({n})")],
+        env=env,
+    ).returncode
 
 
 def main() -> int:
