@@ -122,7 +122,20 @@ def _cpu_fallback(note: str) -> int:
             stdout=subprocess.PIPE,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as exc:
+        # The child may have printed the headline line already (e.g. a
+        # user-forced adversarial stage overran the budget) — a captured
+        # valid measurement must not become a zero.
+        outtxt = (exc.stdout or b"").decode(errors="replace")
+        if '"metric"' in outtxt:
+            print(
+                f"# CPU fallback timed out >{timeout_s:.0f}s after the "
+                "headline line; keeping it",
+                file=sys.stderr,
+            )
+            sys.stdout.write(outtxt)
+            sys.stdout.flush()
+            return 0
         return _zero_line(f"{note} (CPU fallback timed out >{timeout_s:.0f}s)")
     outtxt = proc.stdout.decode(errors="replace")
     if '"metric"' not in outtxt:

@@ -1328,7 +1328,10 @@ def _spill_search(
     in slabs of a device bucket (``f_cap``, raised to at least ``4*C`` so a
     single row's children always fit): auto-close, accept check, one
     expansion, and in-slab dedup all run compiled; exact cross-slab dedup
-    happens host-side (``_dedup_rows``) between layers.  Nothing is ever
+    happens host-side (``_dedup_rows``) between layers.  Whenever the
+    deduped frontier fits back inside half the device bucket, the search
+    resumes fully in-core (multi-layer, no host round-trips) until it
+    overflows again — streaming is paid only at the peak layers.  Nothing is ever
     pruned, so OK and ILLEGAL both stay conclusive; UNKNOWN only when the
     host frontier exceeds ``host_cap`` rows (checked inside the slab loop
     too — transient children are bounded, not just the post-dedup set).
@@ -1337,9 +1340,10 @@ def _spill_search(
     set — a slab-local (possibly partial) view of the accept
     configuration's candidate states; the reference exposes no final
     states at all, so a partial set is still information beyond parity.
-    With ``checkpoint_path``, the host frontier is snapshotted at each
-    layer boundary (``<path>.spill.npz``) and a matching snapshot is
-    resumed from.
+    With ``checkpoint_path``, the host frontier is snapshotted at
+    streamed-layer and in-core-segment boundaries (``<path>.spill.npz``) —
+    a preemption mid-segment replays that segment's layers — and a
+    matching snapshot is resumed from.
     """
     c = enc.num_chains
     # A bucket that always fits one row's <= 2C children, whatever the
@@ -1391,6 +1395,17 @@ def _spill_search(
         stats.pruned = True
         return CheckResult(CheckOutcome.UNKNOWN)
 
+    def conclude(res: CheckResult) -> CheckResult:
+        """A conclusive verdict spends the spill snapshot."""
+        if spill_ck is not None:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(spill_ck)
+        return res
+
+    # A Frontier seed just overflowed the same bucket in the escalation
+    # driver, so an immediate in-core retry would deterministically fail
+    # again; checkpoint-resume ndarray seeds carry no such knowledge.
+    try_incore = isinstance(seed, np.ndarray)
     host = seed if isinstance(seed, np.ndarray) else to_host(seed)
     deep = np.asarray(deep_counts) if deep_counts is not None else None
     deep_sum = int(deep.sum()) if deep is not None else -1
@@ -1409,6 +1424,65 @@ def _spill_search(
                 deep=deep if deep is not None else np.zeros(0, np.int32),
             )
             os.replace(tmp, spill_ck)
+        if try_incore and len(host) <= f_cap // 2:
+            # Hybrid resume: the frontier fits the device bucket again, so
+            # run whole in-core layers (no host round-trips) until it
+            # outgrows the bucket — streaming is paid only at the peak
+            # layers.  f_cap//2 leaves expansion headroom; a segment that
+            # commits no layer (immediate overflow) falls through to one
+            # streamed layer before retrying.
+            out = run_search(
+                tables,
+                to_device(host),
+                np.int32(cap_layers - stats.layers),
+                allow_prune=False,
+            )
+            code, seg_layers, seg_live, seg_ac, seg_ex, accept_idx, dc = (
+                jax.device_get(
+                    (
+                        out.stop_code,
+                        out.layers,
+                        out.max_live,
+                        out.auto_closed,
+                        out.expanded,
+                        out.accept_idx,
+                        out.deep_counts,
+                    )
+                )
+            )
+            code = int(code)
+            stats.layers += int(seg_layers)
+            stats.max_frontier = max(stats.max_frontier, int(seg_live))
+            stats.auto_closed += int(seg_ac)
+            stats.expanded += int(seg_ex)
+            log.debug(
+                "spill in-core segment: stop=%s +%d layers",
+                ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
+                int(seg_layers),
+            )
+            if int(np.asarray(dc).sum()) > deep_sum:
+                deep_sum, deep = int(np.asarray(dc).sum()), np.asarray(dc)
+            if code == STOP_ACCEPT:
+                return conclude(
+                    CheckResult(
+                        CheckOutcome.OK,
+                        linearization=None,
+                        final_states=_final_states_device(
+                            enc, out.frontier, int(accept_idx)
+                        ),
+                    )
+                )
+            if code == STOP_EMPTY:
+                return conclude(
+                    CheckResult(
+                        CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+                    )
+                )
+            # STOP_CAPACITY: back to streaming from the returned
+            # (post-auto-close, pre-expansion) frontier.
+            host = to_host(out.frontier)
+            try_incore = int(seg_layers) > 0
+            continue
         children: list[np.ndarray] = []
         children_rows = 0
         slab = max(1, f_cap // 4)
@@ -1443,17 +1517,15 @@ def _spill_search(
             stats.expanded += int(seg_ex)
             if code == STOP_ACCEPT:
                 stats.layers += 1
-                res = CheckResult(
-                    CheckOutcome.OK,
-                    linearization=None,
-                    final_states=_final_states_device(
-                        enc, out.frontier, int(accept_idx)
-                    ),
+                return conclude(
+                    CheckResult(
+                        CheckOutcome.OK,
+                        linearization=None,
+                        final_states=_final_states_device(
+                            enc, out.frontier, int(accept_idx)
+                        ),
+                    )
                 )
-                if spill_ck is not None:
-                    with contextlib.suppress(FileNotFoundError):
-                        os.remove(spill_ck)
-                return res
             if int(dc.sum()) > deep_sum:
                 deep_sum, deep = int(dc.sum()), dc
             if code != STOP_EMPTY:
@@ -1473,14 +1545,13 @@ def _spill_search(
             i += take
         stats.layers += 1
         if not children:
-            res = CheckResult(
-                CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+            return conclude(
+                CheckResult(
+                    CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+                )
             )
-            if spill_ck is not None:
-                with contextlib.suppress(FileNotFoundError):
-                    os.remove(spill_ck)
-            return res
         host = _dedup_rows(np.concatenate(children))
+        try_incore = True
         stats.max_frontier = max(stats.max_frontier, len(host))
         log.debug(
             "spill layer %d: %d host rows", stats.layers, len(host)
