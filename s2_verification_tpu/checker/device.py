@@ -65,6 +65,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+from collections import deque
 from functools import partial
 from typing import NamedTuple
 
@@ -1335,8 +1336,10 @@ def _spill_search(
     pruned, so OK and ILLEGAL both stay conclusive; UNKNOWN only when the
     host frontier exceeds ``host_cap`` rows (checked inside the slab loop
     too — transient children are bounded, not just the post-dedup set).
-    The slab fill resets each layer and halves within a layer on a growth
-    spike.  On OK the reported ``final_states`` are the accepting *slab's*
+    The slab fill resets each layer; on a growth spike the overflowing
+    range is retried in halves and the layer-wide fill halves with it.
+    Up to two slabs stay in flight so transfers overlap device compute,
+    degrading to one if that second bucket exhausts device memory.  On OK the reported ``final_states`` are the accepting *slab's*
     set — a slab-local (possibly partial) view of the accept
     configuration's candidate states; the reference exposes no final
     states at all, so a partial set is still information beyond parity.
@@ -1485,33 +1488,78 @@ def _spill_search(
             continue
         children: list[np.ndarray] = []
         children_rows = 0
-        slab = max(1, f_cap // 4)
-        i = 0
-        while i < len(host):
-            take = min(slab, len(host) - i)
-            out = run_search(
-                tables,
-                to_device(host[i : i + take]),
-                np.int32(1),
-                allow_prune=False,
-            )
-            # Scalar-only fetch; children cross back compacted (to_host).
-            code, seg_ac, seg_ex, accept_idx, dc = jax.device_get(
-                (
-                    out.stop_code,
-                    out.auto_closed,
-                    out.expanded,
-                    out.accept_idx,
-                    out.deep_counts,
+        fill = max(1, f_cap // 4)
+        # Dispatch-ahead pipeline: keep up to two slabs in flight so D2H of
+        # one slab's children overlaps device compute of the next.  Each
+        # queue entry is an independent (start, length) row range; on a
+        # children overflow the layer-wide fill halves (growth is usually
+        # uniform across rows, so remaining ranges pre-split instead of
+        # each overflowing once) and the failed range is retried in halves.
+        # The one compiled program serves every fill level.  If holding two
+        # buckets exhausts device memory (spill runs exactly when memory is
+        # tight), the pipeline degrades to depth one and retries.
+        work = deque(
+            (j, min(fill, len(host) - j)) for j in range(0, len(host), fill)
+        )
+        inflight: deque = deque()
+        max_inflight = 2
+        while work or inflight:
+            while work and len(inflight) < max_inflight:
+                s0, t0 = work.popleft()
+                if t0 > fill:
+                    work.appendleft((s0 + fill, t0 - fill))
+                    t0 = fill
+                inflight.append(
+                    (
+                        s0,
+                        t0,
+                        run_search(
+                            tables,
+                            to_device(host[s0 : s0 + t0]),
+                            np.int32(1),
+                            allow_prune=False,
+                        ),
+                    )
                 )
-            )
+            s0, t0, out = inflight.popleft()
+            # Scalar-only fetch; children cross back compacted (to_host).
+            try:
+                code, seg_ac, seg_ex, accept_idx, dc = jax.device_get(
+                    (
+                        out.stop_code,
+                        out.auto_closed,
+                        out.expanded,
+                        out.accept_idx,
+                        out.deep_counts,
+                    )
+                )
+            except jax.errors.JaxRuntimeError as e:
+                if "RESOURCE_EXHAUSTED" not in str(e) or max_inflight == 1:
+                    raise
+                log.warning(
+                    "spill pipeline exhausted device memory; degrading to "
+                    "depth 1"
+                )
+                max_inflight = 1
+                work.appendleft((s0, t0))
+                while inflight:
+                    s1, t1, _ = inflight.pop()
+                    work.appendleft((s1, t1))
+                continue
             code = int(code)
             if code == STOP_CAPACITY:
-                if slab == 1:
+                if t0 == 1:
                     # Unreachable: f_cap >= 4C fits one row's children.
                     return unknown()
-                slab = max(1, slab // 2)
-                log.debug("slab overflow: halving fill to %d", slab)
+                half = t0 // 2
+                fill = max(1, min(fill, half))
+                log.debug(
+                    "slab overflow: retrying %d rows in halves, fill -> %d",
+                    t0,
+                    fill,
+                )
+                work.appendleft((s0 + half, t0 - half))
+                work.appendleft((s0, half))
                 continue
             stats.auto_closed += int(seg_ac)
             stats.expanded += int(seg_ex)
@@ -1542,7 +1590,6 @@ def _spill_search(
                         host_cap,
                     )
                     return unknown()
-            i += take
         stats.layers += 1
         if not children:
             return conclude(
