@@ -1503,27 +1503,53 @@ def _spill_search(
         )
         inflight: deque = deque()
         max_inflight = 2
+        def degrade(ranges, outs) -> bool:
+            """Drop to pipeline depth 1 after RESOURCE_EXHAUSTED: requeue
+            the ranges and block until every held result's program has
+            quiesced before dropping it, so its buffers are actually free
+            by the time the depth-1 retry uploads.  Returns False when
+            already at depth 1 (nothing left to shed)."""
+            nonlocal max_inflight
+            if max_inflight == 1:
+                return False
+            log.warning(
+                "spill pipeline exhausted device memory; degrading to depth 1"
+            )
+            max_inflight = 1
+            for r in ranges:
+                work.appendleft(r)
+            for o in outs:
+                with contextlib.suppress(Exception):
+                    jax.block_until_ready(o.stop_code)
+            outs.clear()
+            return True
+
         while work or inflight:
-            while work and len(inflight) < max_inflight:
-                s0, t0 = work.popleft()
-                if t0 > fill:
-                    work.appendleft((s0 + fill, t0 - fill))
-                    t0 = fill
-                inflight.append(
-                    (
-                        s0,
-                        t0,
-                        run_search(
-                            tables,
-                            to_device(host[s0 : s0 + t0]),
-                            np.int32(1),
-                            allow_prune=False,
-                        ),
-                    )
-                )
-            s0, t0, out = inflight.popleft()
-            # Scalar-only fetch; children cross back compacted (to_host).
+            pending_range = None
+            out = None
             try:
+                while work and len(inflight) < max_inflight:
+                    s0, t0 = work.popleft()
+                    if t0 > fill:
+                        work.appendleft((s0 + fill, t0 - fill))
+                        t0 = fill
+                    pending_range = (s0, t0)
+                    inflight.append(
+                        (
+                            s0,
+                            t0,
+                            run_search(
+                                tables,
+                                to_device(host[s0 : s0 + t0]),
+                                np.int32(1),
+                                allow_prune=False,
+                            ),
+                        )
+                    )
+                    pending_range = None
+                s0, t0, out = inflight.popleft()
+                # Scalar-only fetch; children cross back compacted
+                # (to_host).
                 code, seg_ac, seg_ex, accept_idx, dc = jax.device_get(
                     (
                         out.stop_code,
@@ -1534,17 +1560,22 @@ def _spill_search(
                     )
                 )
             except jax.errors.JaxRuntimeError as e:
-                if "RESOURCE_EXHAUSTED" not in str(e) or max_inflight == 1:
+                if "RESOURCE_EXHAUSTED" not in str(e):
                     raise
-                log.warning(
-                    "spill pipeline exhausted device memory; degrading to "
-                    "depth 1"
-                )
-                max_inflight = 1
-                work.appendleft((s0, t0))
-                while inflight:
-                    s1, t1, _ = inflight.pop()
-                    work.appendleft((s1, t1))
+                # Exhaustion can surface at dispatch (to_device upload /
+                # program launch) or at the fetch; requeue whichever ranges
+                # are in limbo and release every held device result.
+                requeue = [pending_range] if pending_range is not None else []
+                if out is not None:
+                    requeue.append((s0, t0))
+                requeue += [(s1, t1) for s1, t1, _ in inflight]
+                outs = [o for _, _, o in inflight]
+                if out is not None:
+                    outs.append(out)
+                inflight.clear()
+                out = None
+                if not degrade(requeue, outs):
+                    raise
                 continue
             code = int(code)
             if code == STOP_CAPACITY:

@@ -42,6 +42,7 @@ from .checker.oracle import CheckOutcome, CheckResult, check
 from .collector.collect import CollectConfig, collect_to_file
 from .collector.fake_s2 import FaultPlan
 from .utils import events as ev
+from .utils.platform import pin_platform
 
 __all__ = ["main"]
 
@@ -82,21 +83,6 @@ def _cpu_check(hist: History, budget: float | None) -> CheckResult:
         return check(hist, time_budget_s=budget)
 
 
-def _pin_platform() -> None:
-    """Make ``JAX_PLATFORMS`` mean what it says for the device backend.
-
-    The axon sitecustomize hook re-registers the TPU plugin at interpreter
-    start and overrides the env var, so ``JAX_PLATFORMS=cpu s2v check
-    -backend=device`` would still try (and, with the tunnel down, hang on)
-    TPU init; re-pinning through the config API before first device use
-    restores the documented env-var semantics."""
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
-        import jax
-
-        jax.config.update("jax_platforms", plat)
-
-
 def _run_backend(
     backend: str,
     hist: History,
@@ -127,7 +113,7 @@ def _run_backend(
 
         return check_frontier_auto(hist)
     if backend == "device":
-        _pin_platform()
+        pin_platform()
         from .checker.device import check_device_auto
 
         return check_device_auto(hist, checkpoint_path=checkpoint)
@@ -143,7 +129,7 @@ def _run_backend(
             "CPU engine hit its %.1fs budget; escalating to the device search",
             budget,
         )
-        _pin_platform()
+        pin_platform()
         from .checker.device import check_device_auto
 
         return check_device_auto(hist, checkpoint_path=checkpoint)

@@ -1,0 +1,25 @@
+"""Platform pinning that survives the axon sitecustomize hook.
+
+The TPU (axon) PJRT plugin registers itself from a sitecustomize module at
+interpreter start and overrides ``JAX_PLATFORMS``, so exporting
+``JAX_PLATFORMS=cpu`` alone does not keep jax off the TPU — and when the
+TPU tunnel is down, backend init *hangs* rather than errors.  Re-pinning
+through the config API before first device use restores the documented
+env-var semantics.  Call this before touching jax in any entry point that
+honors ``JAX_PLATFORMS`` (the CLI device backend, benchmark scripts).
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["pin_platform"]
+
+
+def pin_platform() -> None:
+    """Make ``JAX_PLATFORMS`` mean what it says (no-op when unset)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)

@@ -19,6 +19,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from s2_verification_tpu.utils.platform import pin_platform
+
+pin_platform()
+
 from s2_verification_tpu.checker.entries import prepare
 from s2_verification_tpu.collector.adversarial import (
     adversarial_events,
@@ -43,6 +47,11 @@ def main() -> int:
     ap.add_argument("--beam", action="store_true", help="beam instead of exhaustive")
     ap.add_argument("--spill", action="store_true", help="out-of-core past the frontier cap")
     ap.add_argument("--once", action="store_true", help="skip the steady-state rerun")
+    ap.add_argument(
+        "--profile",
+        metavar="DIR",
+        help="wrap the steady device run in jax.profiler.trace(DIR)",
+    )
     args = ap.parse_args()
 
     for k in [int(x) for x in args.ks.split(",")]:
@@ -79,23 +88,14 @@ def main() -> int:
             print(f"native  k={k}: {r.outcome.name:8s} {dt:10.3f}s steps={r.steps}", flush=True)
 
         if not args.skip_device:
+            import contextlib
+
+            import jax
+
             from s2_verification_tpu.checker.device import check_device
 
-            t0 = time.monotonic()
-            r = check_device(
-                hist,
-                beam=args.beam,
-                max_frontier=args.frontier,
-                start_frontier=args.start_frontier,
-                collect_stats=True,
-                witness=False,
-                spill=args.spill,
-            )
-            warm = time.monotonic() - t0
-            steady = warm
-            if not args.once:
-                t0 = time.monotonic()
-                r = check_device(
+            def run_device():
+                return check_device(
                     hist,
                     beam=args.beam,
                     max_frontier=args.frontier,
@@ -104,7 +104,26 @@ def main() -> int:
                     witness=False,
                     spill=args.spill,
                 )
-                steady = time.monotonic() - t0
+
+            def trace_ctx():
+                # With --once the warm run is the only run, so the profile
+                # wraps it (compile time included) rather than vanishing.
+                return (
+                    jax.profiler.trace(args.profile)
+                    if args.profile
+                    else contextlib.nullcontext()
+                )
+
+            with trace_ctx() if args.once else contextlib.nullcontext():
+                t0 = time.monotonic()
+                r = run_device()
+                warm = time.monotonic() - t0
+            steady = warm
+            if not args.once:
+                with trace_ctx():
+                    t0 = time.monotonic()
+                    r = run_device()
+                    steady = time.monotonic() - t0
             st = r.stats
             print(
                 f"device  k={k}: {r.outcome.name:8s} warm={warm:8.3f}s steady={steady:8.3f}s "

@@ -17,6 +17,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from s2_verification_tpu.utils.platform import pin_platform
+
+pin_platform()
+
 from bench import make_bench_history
 
 CONFIGS = [
