@@ -382,3 +382,51 @@ def test_dedup_rows_collision_separated_duplicates():
     got = np.unique(got, axis=0)
     want = np.unique(mat, axis=0)
     assert (got == want).all()
+
+
+def test_driver_fetches_stay_small():
+    # Transfer-discipline regression guard: with witnessing off, the
+    # driver's happy path must fetch only steering scalars, the [C]
+    # deep-counts row, and the compacted accept set (host<->device traffic
+    # was the k>=10 bottleneck through the tunnel; on any hardware it is
+    # waste).  Both fetch routes are spied — jax.device_get AND
+    # np.asarray-on-device-array — so a regression through either trips.
+    import numpy as np
+
+    import s2_verification_tpu.checker.device as D
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(5, batch=10, seed=2))
+    fetched: list[int] = []
+    real_get = jax.device_get
+    real_asarray = np.asarray
+
+    def record(x):
+        for leaf in jax.tree.leaves(x):
+            if isinstance(leaf, jax.Array):
+                fetched.append(int(leaf.size))
+
+    def spy_get(x):
+        record(x)
+        return real_get(x)
+
+    def spy_asarray(x, *a, **k):
+        record(x)
+        return real_asarray(x, *a, **k)
+
+    D.jax.device_get = spy_get
+    D.np.asarray = spy_asarray
+    try:
+        res = D.check_device(
+            hist, max_frontier=4096, start_frontier=16, beam=False,
+            witness=False,
+        )
+    finally:
+        D.jax.device_get = real_get
+        D.np.asarray = real_asarray
+    assert res.outcome == CheckOutcome.OK
+    assert fetched, "spy saw no fetches"
+    # This search escalates through a few-hundred-row frontier; every
+    # legal fetch above is far smaller still.  A regression that pulls any
+    # whole frontier column (or the counts matrix) exceeds this at once.
+    assert max(fetched) <= 64, f"oversized device fetch: {max(fetched)}"
