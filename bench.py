@@ -3,7 +3,11 @@
 Prints ONE JSON line on stdout (the driver contract):
 
     {"metric": "ops_verified_per_sec_chip", "value": N, "unit": "ops/s",
-     "vs_baseline": R}
+     "vs_baseline": R, "backend": "tpu"|"cpu"|"cpu-fallback"|"none"}
+
+``backend`` is the machine-readable provenance marker: the JAX backend the
+measurement ran on, ``cpu-fallback`` when the TPU probe failed and the
+bench re-ran itself on host cores, ``none`` for a dead zero line.
 
 ``value`` is checked-ops / steady-state device wall-clock on the 5x2000
 `match-seq-num` collector history (first run warms the XLA compile cache;
@@ -70,7 +74,13 @@ def _zero_line(note: str) -> int:
     print(f"# {note}", file=sys.stderr)
     print(
         json.dumps(
-            {"metric": "ops_verified_per_sec_chip", "value": 0.0, "unit": "ops/s", "vs_baseline": 0.0}
+            {
+                "metric": "ops_verified_per_sec_chip",
+                "value": 0.0,
+                "unit": "ops/s",
+                "vs_baseline": 0.0,
+                "backend": "none",
+            }
         ),
         flush=True,
     )
@@ -263,6 +273,16 @@ def north_star() -> int:
     # hang — e.g. a TPU tunnel dropping mid-run).
     target_s = 10.0  # BASELINE.json north star for this config
     value = n_ops / dev_s
+    # Machine-readable backend marker: automated consumers must be able to
+    # tell an on-chip measurement from the host-cores fallback without
+    # parsing stderr.
+    import jax
+
+    backend = (
+        "cpu-fallback"
+        if os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1"
+        else jax.default_backend()
+    )
     print(
         json.dumps(
             {
@@ -270,6 +290,7 @@ def north_star() -> int:
                 "value": round(value, 2),
                 "unit": "ops/s",
                 "vs_baseline": round(target_s / dev_s, 3),
+                "backend": backend,
             }
         ),
         flush=True,

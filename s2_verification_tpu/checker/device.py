@@ -97,6 +97,13 @@ enable_persistent_cache()
 
 log = logging.getLogger("s2_verification_tpu.device")
 
+#: The module's host<->device fetch surface.  Every driver fetch goes
+#: through these module-level names (not the jax/np globals) so the
+#: transfer-discipline regression test can spy on exactly this module's
+#: fetches without patching the process-global functions.
+device_get = jax.device_get
+asarray = np.asarray
+
 _I32 = jnp.int32
 _U32 = jnp.uint32
 
@@ -705,7 +712,7 @@ def _final_states_device(
     resource — see check_device)."""
     tails, his, los, toks, m = _accept_set_device(frontier, np.int32(idx))
     m = int(m)
-    tails, his, los, toks = jax.device_get(
+    tails, his, los, toks = device_get(
         (tails[:m], his[:m], los[:m], toks[:m])
     )
     out = {
@@ -716,6 +723,52 @@ def _final_states_device(
         )
         for i in range(m)
     }
+    return sorted(out)
+
+
+@jax.jit
+def _accept_sweep_device(tables: SearchTables, fr: Frontier, accept_counts):
+    """Auto-close every row, then compact the states of rows whose closed
+    counts equal the accept configuration's — one slab's piece of the
+    accept set.  The accept check runs post-auto-close in the compiled
+    layer, so the sweep applies the same (deterministic) closure before
+    matching."""
+    closed, _ = jax.vmap(
+        lambda cnt, tl, tk, v: _auto_close_row(tables, cnt, tl, tk, v)
+    )(fr.counts, fr.tail, fr.tok, fr.valid)
+    match = fr.valid & (closed == accept_counts[None, :]).all(axis=1)
+    _, tail, hi, lo, tok, n = _compact_rows_device(fr._replace(valid=match))
+    return tail, hi, lo, tok, n
+
+
+def _spill_accept_states(
+    enc: EncodedHistory,
+    tables: SearchTables,
+    host: np.ndarray,
+    accept_counts: np.ndarray,
+    to_device,
+    fill: int,
+) -> list[StreamState]:
+    """Accept-configuration candidate states unioned across EVERY slab of
+    the accept layer, not just the slab that happened to accept first — so
+    a spill OK reports the same ``final_states`` as the in-core path
+    (``_final_states_device``).  One extra upload-only sweep of the layer;
+    auto-close never grows a slab, so the sweep reuses the same buckets."""
+    out: set[StreamState] = set()
+    acc = jnp.asarray(accept_counts)
+    for j in range(0, len(host), fill):
+        fr = to_device(host[j : j + fill])
+        tail, hi, lo, tok, n = _accept_sweep_device(tables, fr, acc)
+        n = int(n)
+        tail, hi, lo, tok = device_get((tail[:n], hi[:n], lo[:n], tok[:n]))
+        for i in range(n):
+            out.add(
+                StreamState(
+                    tail=int(tail[i]),
+                    stream_hash=(int(hi[i]) << 32) | int(lo[i]),
+                    fencing_token=enc.token_of_id[int(tok[i])],
+                )
+            )
     return sorted(out)
 
 
@@ -802,18 +855,26 @@ def check_device(
     Logging is dropped — the verdict is unaffected — once the frontier
     escalates past ``witness_max_frontier`` (the log costs O(layers x F)
     device memory) or when resuming from a checkpoint (earlier layers'
-    logs are gone).
+    logs are gone); an OK verdict then recovers a linearization anyway
+    via the counts-bounded host re-search (:func:`_recover_witness_bounded`),
+    so a requested witness survives every scale the engine decides at.
 
     ``spill=True`` (exhaustive mode only): when the frontier outgrows
     ``max_frontier``, spill it to host RAM and stream slabs through the
     chip — layer by layer, each slab one compiled single-layer pass, with
     exact host-side dedup between layers — instead of conceding UNKNOWN.
     Out-of-core exhaustion stays conclusive (nothing is ever dropped) up
-    to ``spill_host_cap`` host rows; the witness log does not survive the
-    spill, so OK verdicts carry no linearization.  A capability past the
-    reference, whose search is bounded by one process's memory.
+    to ``spill_host_cap`` host rows; the per-layer witness log does not
+    survive the spill, but an OK verdict still recovers a linearization
+    from the accept counts (:func:`_recover_witness_bounded`).  A
+    capability past the reference, whose search is bounded by one
+    process's memory.
     """
     del state_slots
+    # Whether the CALLER wants a witness; the working ``witness`` flag may
+    # be dropped mid-run (cap, resume, spill), after which an OK verdict
+    # falls back to counts-bounded recovery (_recover_witness_bounded).
+    witness_requested = witness
     enc = encode_history(history)
     stats = FrontierStats()
     if enc.total_remaining == 0:
@@ -860,11 +921,11 @@ def check_device(
                     "exhaustive spill-enabled run to resume"
                 )
             stats.layers = int(data["layers"])
-            deep0 = np.asarray(data["deep"])
+            deep0 = asarray(data["deep"])
             res = _spill_search(
                 enc,
                 tables,
-                np.asarray(data["host"]),
+                asarray(data["host"]),
                 stats,
                 _floor_pow2(max_frontier, 2),
                 int(enc.total_remaining) + 2,
@@ -873,6 +934,8 @@ def check_device(
                 deep_counts=deep0 if len(deep0) else None,
                 checkpoint_path=checkpoint_path,
                 fingerprint=fingerprint,
+                history=history,
+                witness_requested=witness_requested,
             )
             if res.outcome != CheckOutcome.UNKNOWN:
                 with contextlib.suppress(FileNotFoundError):
@@ -917,12 +980,12 @@ def check_device(
                 checkpoint_path,
                 Checkpoint(
                     fingerprint=fingerprint,
-                    counts=np.asarray(fr.counts),
-                    tail=np.asarray(fr.tail),
-                    hi=np.asarray(fr.hi),
-                    lo=np.asarray(fr.lo),
-                    tok=np.asarray(fr.tok),
-                    valid=np.asarray(fr.valid),
+                    counts=asarray(fr.counts),
+                    tail=asarray(fr.tail),
+                    hi=asarray(fr.hi),
+                    lo=asarray(fr.lo),
+                    tok=asarray(fr.tok),
+                    valid=asarray(fr.valid),
                     f=f,
                     beam=beam,
                     layers_done=stats.layers,
@@ -983,7 +1046,7 @@ def check_device(
             accept_idx,
             deep_np,
             live,
-        ) = jax.device_get(
+        ) = device_get(
             (
                 out.stop_code,
                 out.layers,
@@ -1023,7 +1086,7 @@ def check_device(
             # Only the committed slice of the log is transferred.
             n_rows = int(seg_layers) - (1 if code == STOP_ACCEPT else 0)
             if n_rows > 0:
-                wp, wo = jax.device_get(
+                wp, wo = device_get(
                     (out.wparent[:n_rows], out.wop[:n_rows])
                 )
                 for l in range(n_rows):
@@ -1035,6 +1098,14 @@ def check_device(
                 if witness
                 else None
             )
+            if lin is None and witness_requested:
+                # Log dropped (witness cap / checkpoint resume) or
+                # inconsistent: recover from the accept counts instead.
+                lin = _recover_witness_bounded(
+                    enc,
+                    history,
+                    device_get(out.frontier.counts[int(accept_idx)]),
+                )
             res = CheckResult(
                 CheckOutcome.OK,
                 linearization=lin,
@@ -1062,7 +1133,7 @@ def check_device(
                 if mesh is not None:
                     frontier = place_frontier(frontier, mesh)
                 if checkpoint_path is not None:
-                    _snapshot(Frontier(*(np.asarray(x) for x in frontier)))
+                    _snapshot(Frontier(*(asarray(x) for x in frontier)))
                 continue
             if not beam and spill:
                 # Out-of-core hand-off: the frontier goes to the host here
@@ -1080,6 +1151,8 @@ def check_device(
                     deep_counts=deep_counts,
                     checkpoint_path=checkpoint_path,
                     fingerprint=fingerprint if checkpoint_path else None,
+                    history=history,
+                    witness_requested=witness_requested,
                 )
                 break
             stats.pruned = True
@@ -1091,7 +1164,7 @@ def check_device(
             # the device unless a checkpoint file asked for a host copy.
             frontier = out.frontier
             if checkpoint_path is not None:
-                _snapshot(Frontier(*(np.asarray(x) for x in frontier)))
+                _snapshot(Frontier(*(asarray(x) for x in frontier)))
             continue
         # Layer cap hit without a verdict: should be impossible (each layer
         # linearizes exactly one op); treat as inconclusive.
@@ -1184,7 +1257,6 @@ def _witness_linearization(
 
     from ..utils.hashing import fold_record_hashes
 
-    is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
     counts = np.array(enc.chain_start, np.int64)
     order: list[int] = []
 
@@ -1215,9 +1287,24 @@ def _witness_linearization(
             apply_effect(j)
     order.extend(_host_close(enc, counts, tail, tok))
 
-    # The accept configuration's remaining ops are all indefinite appends;
-    # linearizing them in call order respects both chain order and real time
-    # (each remaining op's no-effect branch is unconditionally valid).
+    remaining = _accept_remaining(enc, counts)
+    if remaining is None:
+        return None
+    order.extend(remaining)
+
+    ki = enc.keep_index()
+    return list(enc.forced_prefix) + [ki[j] for j in order]
+
+
+def _accept_remaining(enc: EncodedHistory, counts) -> list[int] | None:
+    """The accept configuration's remaining ops in call order — the shared
+    completion tail of both witness paths (log walk and counts-bounded
+    recovery).  The remaining ops are all indefinite appends (that is what
+    accept means), and linearizing them in call order respects both chain
+    order and real time (each one's no-effect branch is unconditionally
+    valid); returns None if a remainder is not an indefinite append (never
+    expected)."""
+    is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
     remaining = [
         int(enc.chain_ops[c, k])
         for c in range(enc.num_chains)
@@ -1227,9 +1314,127 @@ def _witness_linearization(
         log.warning("witness accept state has non-indefinite remainders")
         return None
     remaining.sort(key=lambda j: int(enc.call[j]))
-    order.extend(remaining)
+    return remaining
+
+
+def _recover_witness_bounded(
+    enc: EncodedHistory,
+    history: History,
+    accept_counts,
+    node_budget: int = 500_000,
+) -> list[int] | None:
+    """Recover a linearization when the per-layer witness log is gone
+    (frontier beyond the witness cap, checkpoint resume, out-of-core
+    spill).
+
+    The OK verdict hands us the accept configuration's counts vector, and
+    that vector collapses the problem: a witness only needs a valid order
+    of the ops *below* it (every chain's accept prefix), so the search
+    space shrinks from all reachable configurations to the sub-lattice
+    ``counts <= accept_counts`` — for the adversarial family that is the
+    orderings of the applied subset (~k! / e), thousands of nodes where
+    the full search needed millions of rows.  A plain host Wing–Gong DFS
+    with (counts, state) memoization walks it in milliseconds; the
+    remaining (all-indefinite-append) ops complete the order as in
+    :func:`_witness_linearization`.  Returns None (witness omitted, the
+    verdict-only behavior) if the node budget is exhausted — possible
+    only when the accept prefix is itself search-hard, which the huge-
+    frontier regimes this path serves never are.
+
+    Reference analog: the linearization info ``CheckEventsVerbose`` hands
+    ``Visualize`` (golang/s2-porcupine/main.go:605-631), which the
+    reference produces at every scale its engine can decide.
+    """
+    from ..models.stream import step_set
 
     ki = enc.keep_index()
+    n_chains = enc.num_chains
+    target = np.asarray(accept_counts, np.int64)
+    counts0 = np.asarray(enc.chain_start, np.int64)
+    chain_len = np.asarray(enc.chain_len, np.int64)
+    if (target < counts0).any() or (target > chain_len).any():
+        log.warning("witness recovery: accept counts out of range; omitting")
+        return None
+
+    prefix_ops = [
+        int(enc.chain_ops[c, k])
+        for c in range(n_chains)
+        for k in range(int(counts0[c]), int(target[c]))
+    ]
+    remaining = _accept_remaining(enc, target)
+    if remaining is None:
+        return None
+    # Completion soundness (same property _witness_linearization relies
+    # on): appending the remaining ops after the whole prefix respects
+    # real time iff no remaining op returned before a prefix op's call.
+    # Reachability of the accept row guarantees it; check anyway.
+    if prefix_ops and remaining:
+        if min(int(enc.ret[j]) for j in remaining) < max(
+            int(enc.call[j]) for j in prefix_ops
+        ):
+            log.warning(
+                "witness recovery: completion would violate real-time "
+                "order; omitting"
+            )
+            return None
+
+    def skey(s):
+        return (s.tail, s.stream_hash, s.fencing_token)
+
+    tt = tuple(int(x) for x in target)
+    start = tuple(int(x) for x in counts0)
+    parent: dict = {}
+    stack = []
+    for s in enc.init_states:
+        key = (start, skey(s))
+        if key not in parent:
+            parent[key] = None
+            stack.append((start, s))
+    budget = node_budget
+    goal_key = None
+    while stack:
+        counts_t, state = stack.pop()
+        if counts_t == tt:
+            goal_key = (counts_t, skey(state))
+            break
+        counts = np.asarray(counts_t, np.int64)
+        nxt, cand = _host_next_cands(enc, counts)
+        for c in range(n_chains):
+            if not cand[c] or counts_t[c] >= tt[c]:
+                continue
+            j = int(nxt[c])
+            op = history.ops[ki[j]]
+            nct = counts_t[:c] + (counts_t[c] + 1,) + counts_t[c + 1 :]
+            for ns in step_set([state], op.inp, op.out):
+                key = (nct, skey(ns))
+                if key in parent:
+                    continue
+                budget -= 1
+                if budget <= 0:
+                    log.warning(
+                        "witness recovery exhausted its %d-node budget; "
+                        "omitting witness",
+                        node_budget,
+                    )
+                    return None
+                parent[key] = ((counts_t, skey(state)), j)
+                stack.append((nct, ns))
+    if goal_key is None:
+        # Never expected: the device search proved the configuration
+        # reachable.
+        log.warning(
+            "witness recovery found no path to the accept configuration; "
+            "omitting witness"
+        )
+        return None
+
+    order: list[int] = []
+    node = goal_key
+    while parent[node] is not None:
+        node, j = parent[node]
+        order.append(j)
+    order.reverse()
+    order.extend(remaining)
     return list(enc.forced_prefix) + [ki[j] for j in order]
 
 
@@ -1237,7 +1442,7 @@ def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
     """History op indices of the deepest committed row's linearized set."""
     if deep_counts is None:
         return list(enc.forced_prefix)
-    chain_ops = np.asarray(enc.chain_ops)
+    chain_ops = asarray(enc.chain_ops)
     out = list(enc.forced_prefix)
     keep_index = enc.keep_index()
     for c in range(chain_ops.shape[0]):
@@ -1322,6 +1527,8 @@ def _spill_search(
     deep_counts,
     checkpoint_path: str | None = None,
     fingerprint: str | None = None,
+    history: History | None = None,
+    witness_requested: bool = False,
 ) -> CheckResult:
     """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
 
@@ -1339,10 +1546,14 @@ def _spill_search(
     The slab fill resets each layer; on a growth spike the overflowing
     range is retried in halves and the layer-wide fill halves with it.
     Up to two slabs stay in flight so transfers overlap device compute,
-    degrading to one if that second bucket exhausts device memory.  On OK the reported ``final_states`` are the accepting *slab's*
-    set — a slab-local (possibly partial) view of the accept
-    configuration's candidate states; the reference exposes no final
-    states at all, so a partial set is still information beyond parity.
+    degrading to one if that second bucket exhausts device memory.  On OK
+    the reported ``final_states`` are the accept configuration's full
+    candidate-state set, unioned across every slab of the accept layer by
+    a second upload-only sweep (``_spill_accept_states``) — identical to
+    the in-core result; and when ``witness_requested`` (the caller asked
+    ``check_device(witness=True)``), a linearization is recovered from
+    the accept counts (``_recover_witness_bounded``) even though the
+    per-layer log cannot survive the spill.
     With ``checkpoint_path``, the host frontier is snapshotted at
     streamed-layer and in-core-segment boundaries (``<path>.spill.npz``) —
     a preemption mid-segment replays that segment's layers — and a
@@ -1359,7 +1570,7 @@ def _spill_search(
         # crosses the host boundary (the padded bucket tail never does).
         counts, tail, hi, lo, tok, n = _compact_rows_device(fr)
         n = int(n)
-        counts, tail, hi, lo, tok = jax.device_get(
+        counts, tail, hi, lo, tok = device_get(
             (counts[:n], tail[:n], hi[:n], lo[:n], tok[:n])
         )
         mat = np.empty((n, c + 4), np.int32)
@@ -1410,7 +1621,7 @@ def _spill_search(
     # again; checkpoint-resume ndarray seeds carry no such knowledge.
     try_incore = isinstance(seed, np.ndarray)
     host = seed if isinstance(seed, np.ndarray) else to_host(seed)
-    deep = np.asarray(deep_counts) if deep_counts is not None else None
+    deep = asarray(deep_counts) if deep_counts is not None else None
     deep_sum = int(deep.sum()) if deep is not None else -1
     log.debug(
         "spilling to host: %d rows, device bucket %d", len(host), f_cap
@@ -1441,7 +1652,7 @@ def _spill_search(
                 allow_prune=False,
             )
             code, seg_layers, seg_live, seg_ac, seg_ex, accept_idx, dc = (
-                jax.device_get(
+                device_get(
                     (
                         out.stop_code,
                         out.layers,
@@ -1463,13 +1674,22 @@ def _spill_search(
                 ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
                 int(seg_layers),
             )
-            if int(np.asarray(dc).sum()) > deep_sum:
-                deep_sum, deep = int(np.asarray(dc).sum()), np.asarray(dc)
+            if int(asarray(dc).sum()) > deep_sum:
+                deep_sum, deep = int(asarray(dc).sum()), asarray(dc)
             if code == STOP_ACCEPT:
+                lin = (
+                    _recover_witness_bounded(
+                        enc,
+                        history,
+                        device_get(out.frontier.counts[int(accept_idx)]),
+                    )
+                    if witness_requested and history is not None
+                    else None
+                )
                 return conclude(
                     CheckResult(
                         CheckOutcome.OK,
-                        linearization=None,
+                        linearization=lin,
                         final_states=_final_states_device(
                             enc, out.frontier, int(accept_idx)
                         ),
@@ -1482,9 +1702,13 @@ def _spill_search(
                     )
                 )
             # STOP_CAPACITY: back to streaming from the returned
-            # (post-auto-close, pre-expansion) frontier.
+            # (post-auto-close, pre-expansion) frontier.  The frontier just
+            # proved it cannot expand in-core, so re-running it in-core
+            # (even after committed layers) would deterministically
+            # capacity-stop again with 0 layers — one wasted full-bucket
+            # run.  The streamed layer's dedup re-enables try_incore.
             host = to_host(out.frontier)
-            try_incore = int(seg_layers) > 0
+            try_incore = False
             continue
         children: list[np.ndarray] = []
         children_rows = 0
@@ -1550,7 +1774,7 @@ def _spill_search(
                 s0, t0, out = inflight.popleft()
                 # Scalar-only fetch; children cross back compacted
                 # (to_host).
-                code, seg_ac, seg_ex, accept_idx, dc = jax.device_get(
+                code, seg_ac, seg_ex, accept_idx, dc = device_get(
                     (
                         out.stop_code,
                         out.auto_closed,
@@ -1575,7 +1799,28 @@ def _spill_search(
                 inflight.clear()
                 out = None
                 if not degrade(requeue, outs):
-                    raise
+                    # Already at depth 1: a single fill-sized slab does not
+                    # fit.  Shed load further by halving the layer-wide
+                    # fill (dispatch re-splits oversized queued ranges), so
+                    # the memory-tight regime spill exists for degrades
+                    # gracefully instead of crashing check_device.
+                    if fill == 1:
+                        raise
+                    fill = max(1, fill // 2)
+                    log.warning(
+                        "spill slab exhausted device memory at depth 1; "
+                        "halving fill -> %d",
+                        fill,
+                    )
+                    for r in requeue:
+                        work.appendleft(r)
+                    for o in outs:
+                        with contextlib.suppress(Exception):
+                            jax.block_until_ready(o.stop_code)
+                    # Drop the quiesced results so their f_cap-sized buffers
+                    # are actually free before the halved-fill retry uploads
+                    # (mirrors degrade()).
+                    outs.clear()
                 continue
             code = int(code)
             if code == STOP_CAPACITY:
@@ -1596,12 +1841,23 @@ def _spill_search(
             stats.expanded += int(seg_ex)
             if code == STOP_ACCEPT:
                 stats.layers += 1
+                # The accepting slab holds only its own share of the accept
+                # configuration's candidate-state set; sweep every slab of
+                # this layer so the reported set matches the in-core path.
+                accept_counts = device_get(
+                    out.frontier.counts[int(accept_idx)]
+                )
+                lin = (
+                    _recover_witness_bounded(enc, history, accept_counts)
+                    if witness_requested and history is not None
+                    else None
+                )
                 return conclude(
                     CheckResult(
                         CheckOutcome.OK,
-                        linearization=None,
-                        final_states=_final_states_device(
-                            enc, out.frontier, int(accept_idx)
+                        linearization=lin,
+                        final_states=_spill_accept_states(
+                            enc, tables, host, accept_counts, to_device, fill
                         ),
                     )
                 )

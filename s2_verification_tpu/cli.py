@@ -19,7 +19,11 @@ Backends for ``check``:
 - ``device``   — the compiled TPU frontier search.
 - ``auto``     — native (or oracle) with a time budget, escalating to the
                  device search when the budget expires (CPU stays the default
-                 path; the accelerator handles what the CPU cannot).
+                 path; the accelerator handles what the CPU cannot).  If the
+                 device search is itself inconclusive and the user set no
+                 explicit budget, an unbounded CPU run closes the check —
+                 reference semantics are unbounded (timeout 0, main.go:606),
+                 so no decidable instance is ever conceded.
 
 Exit codes: 0 linearizable, 1 not linearizable, 2 inconclusive, 64 usage /
 decode errors (argparse usage errors included; the reference distinguishes
@@ -132,7 +136,18 @@ def _run_backend(
         pin_platform()
         from .checker.device import check_device_auto
 
-        return check_device_auto(hist, checkpoint_path=checkpoint)
+        res = check_device_auto(hist, checkpoint_path=checkpoint)
+        if res.outcome != CheckOutcome.UNKNOWN or time_budget_s is not None:
+            return res
+        # Device caps exhausted (beam + exhaustive + spill) with no
+        # user-imposed bound: the reference's default is unbounded
+        # (CheckEventsVerbose timeout 0, main.go:606), so never concede a
+        # decidable instance — close with an unbounded CPU run.
+        log.info(
+            "device search inconclusive; falling back to the unbounded "
+            "CPU engine (no -time-budget was set)"
+        )
+        return _cpu_check(hist, None)
     raise ValueError(f"unknown backend {backend!r}")
 
 

@@ -46,6 +46,12 @@ def main() -> int:
     ap.add_argument("--start-frontier", type=int, default=1 << 12)
     ap.add_argument("--beam", action="store_true", help="beam instead of exhaustive")
     ap.add_argument("--spill", action="store_true", help="out-of-core past the frontier cap")
+    ap.add_argument(
+        "--witness",
+        action="store_true",
+        help="request a linearization (counts-bounded recovery at scale) "
+        "and validate it independently",
+    )
     ap.add_argument("--once", action="store_true", help="skip the steady-state rerun")
     ap.add_argument(
         "--profile",
@@ -101,7 +107,7 @@ def main() -> int:
                     max_frontier=args.frontier,
                     start_frontier=args.start_frontier,
                     collect_stats=True,
-                    witness=False,
+                    witness=args.witness,
                     spill=args.spill,
                 )
 
@@ -130,6 +136,34 @@ def main() -> int:
                 f"layers={st.layers} max_live={st.max_frontier} expanded={st.expanded}",
                 flush=True,
             )
+            if args.witness and r.outcome.name == "OK":
+                from s2_verification_tpu.models.stream import INIT_STATE, step_set
+
+                lin = r.linearization
+                ok = lin is not None and sorted(lin) == list(range(len(hist.ops)))
+                if ok:
+                    states = [INIT_STATE]
+                    pos = {j: i for i, j in enumerate(lin)}
+                    ok = all(
+                        pos[a.index] < pos[b.index]
+                        for a in hist.ops
+                        for b in hist.ops
+                        if a.ret < b.call
+                    )
+                    for j in lin:
+                        states = step_set(states, hist.ops[j].inp, hist.ops[j].out)
+                        if not states:
+                            ok = False
+                            break
+                print(
+                    f"witness k={k}: "
+                    + (
+                        f"{len(lin)} ops, independently VALID"
+                        if ok
+                        else f"INVALID or missing ({'none' if lin is None else len(lin)})"
+                    ),
+                    flush=True,
+                )
     return 0
 
 

@@ -224,3 +224,93 @@ def test_viz_outlines_deepest_on_unknown(tmp_path):
     assert res.deepest
     html_text = render_html(prepare(events, elide_trivial=False), res, checked=checked)
     assert "deepest linearized prefix" in html_text
+
+
+def test_auto_unknown_device_falls_back_to_unbounded_cpu(
+    history_path, monkeypatch
+):
+    # VERDICT r2 #6: when the device search exhausts its caps (UNKNOWN) and
+    # the user set no explicit budget, auto must close the check with an
+    # unbounded CPU run instead of conceding exit 2 — reference semantics
+    # are unbounded (CheckEventsVerbose timeout 0, main.go:606).  The
+    # budgeted CPU pass and the device search are stubbed inconclusive; the
+    # real unbounded CPU engine then decides the instance.
+    import s2_verification_tpu.checker.device as device
+    import s2_verification_tpu.cli as cli
+    from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+
+    real_cpu_check = cli._cpu_check
+
+    def budgeted_unknown(hist, budget):
+        if budget is not None:
+            return CheckResult(CheckOutcome.UNKNOWN)
+        return real_cpu_check(hist, None)
+
+    monkeypatch.setattr(cli, "_cpu_check", budgeted_unknown)
+    monkeypatch.setattr(
+        device,
+        "check_device_auto",
+        lambda hist, **kw: CheckResult(CheckOutcome.UNKNOWN),
+    )
+    rc = main(
+        ["check", "-file", history_path, "-backend", "auto", "-no-viz"]
+    )
+    assert rc == 0
+
+
+def test_auto_unknown_respects_explicit_finite_budget(
+    history_path, monkeypatch
+):
+    # With a user-imposed finite budget the inconclusive verdict stands:
+    # auto must NOT launch an unbounded run the user bounded away.
+    import s2_verification_tpu.checker.device as device
+    import s2_verification_tpu.cli as cli
+    from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+
+    def no_unbounded(hist, budget):
+        assert budget is not None, "auto ran an unbounded CPU pass"
+        return CheckResult(CheckOutcome.UNKNOWN)
+
+    monkeypatch.setattr(cli, "_cpu_check", no_unbounded)
+    monkeypatch.setattr(
+        device,
+        "check_device_auto",
+        lambda hist, **kw: CheckResult(CheckOutcome.UNKNOWN),
+    )
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "auto",
+            "-time-budget",
+            "5",
+            "-no-viz",
+        ]
+    )
+    assert rc == 2
+
+
+def test_auto_time_budget_zero_never_touches_device(history_path, monkeypatch):
+    # -time-budget 0 under auto is the pure unbounded CPU path; the device
+    # backend must not even be imported into the run.
+    import s2_verification_tpu.checker.device as device
+
+    def boom(hist, **kw):
+        raise AssertionError("device search launched under -time-budget 0")
+
+    monkeypatch.setattr(device, "check_device_auto", boom)
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "auto",
+            "-time-budget",
+            "0",
+            "-no-viz",
+        ]
+    )
+    assert rc == 0

@@ -250,8 +250,11 @@ def test_device_witness_adversarial():
     _assert_valid_linearization(hist, res.linearization)
 
 
-def test_device_witness_dropped_beyond_cap():
-    # Past witness_max_frontier the log is dropped but the verdict stands.
+def test_device_witness_recovered_beyond_cap():
+    # Past witness_max_frontier the per-layer log is dropped, but an OK
+    # verdict now recovers a witness via the counts-bounded host re-search
+    # (VERDICT r2 #2) — the regime the chip exists for must not produce a
+    # poorer artifact than the reference's Visualize info (main.go:605-631).
     from s2_verification_tpu.collector.adversarial import adversarial_events
 
     hist = prepare(adversarial_events(5, batch=4, seed=1))
@@ -260,7 +263,37 @@ def test_device_witness_dropped_beyond_cap():
         witness_max_frontier=16,
     )
     assert res.outcome == CheckOutcome.OK
+    assert res.linearization is not None
+    _assert_valid_linearization(hist, res.linearization)
+
+
+def test_device_witness_off_means_off():
+    # witness=False is a caller choice: no log, no recovery.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(5, batch=4, seed=1))
+    res = check_device(
+        hist, max_frontier=4096, start_frontier=16, beam=False,
+        witness=False,
+    )
+    assert res.outcome == CheckOutcome.OK
     assert res.linearization is None
+
+
+def test_spill_witness_recovered():
+    # The witness log cannot survive the out-of-core spill; the recovered
+    # linearization must still validate independently.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    res = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        collect_stats=True,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.max_frontier > 32
+    assert res.linearization is not None
+    _assert_valid_linearization(hist, res.linearization)
 
 
 def test_spill_matches_oracle_on_random_histories():
@@ -317,6 +350,68 @@ def test_spill_adversarial_conclusive():
     )
     assert res.outcome == CheckOutcome.ILLEGAL
     assert res.deepest  # diagnostics survive the spill
+
+
+def test_spill_final_states_match_incore():
+    # VERDICT r2 #4: a spill OK must report the same accept-configuration
+    # candidate-state set as the in-core search — unioned across every slab
+    # of the accept layer, not just the slab that accepted first.
+    #
+    # The adversarial family's accept set is provably a singleton (the
+    # pinning read determines the state), so graft on two RETURNED
+    # ambiguous appends (hashes X / Y) followed by a CheckTailSuccess
+    # whose call opens after both finishes: real-time order forces both
+    # appends into every accept configuration, and the check-tail pins
+    # only the TAIL (exactly one of the two applied) — so the branch-swap
+    # rows (X-applied vs Y-applied) share the accept counts with
+    # different stream hashes: a genuine 2-state accept set.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+    from s2_verification_tpu.utils import events as ev
+
+    k = 6
+    batch, applied = 4, 3
+    base = adversarial_events(k, batch=batch, seed=1, applied=applied)
+    deferred, events = base[-k:], base[:-k]
+    for j, h in enumerate((0xDEADBEEF, 0xCAFEF00D)):
+        events.append(
+            ev.LabeledEvent(
+                ev.AppendStart(num_records=1, record_hashes=(h,)),
+                client_id=k + 2 + j,
+                op_id=k + 1 + j,
+            )
+        )
+        events.append(
+            ev.LabeledEvent(
+                ev.AppendIndefiniteFailure(),
+                client_id=k + 2 + j,
+                op_id=k + 1 + j,
+            )
+        )
+    events.append(
+        ev.LabeledEvent(ev.CheckTailStart(), client_id=k + 4, op_id=k + 3)
+    )
+    events.append(
+        ev.LabeledEvent(
+            ev.CheckTailSuccess(tail=applied * batch + 1),
+            client_id=k + 4,
+            op_id=k + 3,
+        )
+    )
+    hist = prepare(events + deferred)
+
+    incore = check_device(
+        hist, max_frontier=1 << 13, start_frontier=1 << 13, beam=False,
+        witness=False,
+    )
+    spilled = check_device(
+        hist, max_frontier=32, start_frontier=32, beam=False, spill=True,
+        collect_stats=True,
+    )
+    assert incore.outcome == CheckOutcome.OK
+    assert spilled.outcome == CheckOutcome.OK
+    assert spilled.stats.max_frontier > 32  # genuinely out-of-core
+    assert len(incore.final_states) > 1  # the set is non-trivial
+    assert spilled.final_states == incore.final_states
 
 
 def test_spill_host_cap_gives_unknown():
@@ -384,22 +479,23 @@ def test_dedup_rows_collision_separated_duplicates():
     assert (got == want).all()
 
 
-def test_driver_fetches_stay_small():
+def test_driver_fetches_stay_small(monkeypatch):
     # Transfer-discipline regression guard: with witnessing off, the
     # driver's happy path must fetch only steering scalars, the [C]
     # deep-counts row, and the compacted accept set (host<->device traffic
     # was the k>=10 bottleneck through the tunnel; on any hardware it is
-    # waste).  Both fetch routes are spied — jax.device_get AND
-    # np.asarray-on-device-array — so a regression through either trips.
-    import numpy as np
-
+    # waste).  The driver fetches exclusively through the module-level
+    # aliases D.device_get / D.asarray, so patching those module
+    # attributes spies on exactly this module's fetch surface — other
+    # callers in the process (parallel tests, jax internals) are
+    # untouched, and monkeypatch restores them exception-safely.
     import s2_verification_tpu.checker.device as D
     from s2_verification_tpu.collector.adversarial import adversarial_events
 
     hist = prepare(adversarial_events(5, batch=10, seed=2))
     fetched: list[int] = []
-    real_get = jax.device_get
-    real_asarray = np.asarray
+    real_get = D.device_get
+    real_asarray = D.asarray
 
     def record(x):
         for leaf in jax.tree.leaves(x):
@@ -414,16 +510,12 @@ def test_driver_fetches_stay_small():
         record(x)
         return real_asarray(x, *a, **k)
 
-    D.jax.device_get = spy_get
-    D.np.asarray = spy_asarray
-    try:
-        res = D.check_device(
-            hist, max_frontier=4096, start_frontier=16, beam=False,
-            witness=False,
-        )
-    finally:
-        D.jax.device_get = real_get
-        D.np.asarray = real_asarray
+    monkeypatch.setattr(D, "device_get", spy_get)
+    monkeypatch.setattr(D, "asarray", spy_asarray)
+    res = D.check_device(
+        hist, max_frontier=4096, start_frontier=16, beam=False,
+        witness=False,
+    )
     assert res.outcome == CheckOutcome.OK
     assert fetched, "spy saw no fetches"
     # This search escalates through a few-hundred-row frontier; every
