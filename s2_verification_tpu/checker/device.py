@@ -606,7 +606,10 @@ def run_search(
             jnp.where(empty, STOP_EMPTY, jnp.where(need_cap, STOP_CAPACITY, STOP_RUNNING)),
         ).astype(_I32)
 
-        resume = accept_any | need_cap
+        # On accept/capacity the caller needs the pre-expansion frontier to
+        # conclude or resume; on extinction it needs the same thing for
+        # refusal diagnostics (which rows died, and on which ops).
+        resume = accept_any | need_cap | empty
         nxt = jax.tree.map(
             lambda a, b: jnp.where(
                 resume.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
@@ -1116,7 +1119,11 @@ def check_device(
             break
         if code == STOP_EMPTY:
             outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
-            res = CheckResult(outcome, deepest=_deepest_ops(enc, deep_counts))
+            res = CheckResult(
+                outcome,
+                deepest=_deepest_ops(enc, deep_counts),
+                refusals=_device_refusals(enc, history, out.frontier),
+            )
             break
         if code == STOP_CAPACITY:
             # Capacity wall below the cap: escalate and resume from the
@@ -1453,6 +1460,96 @@ def _deepest_ops(enc: EncodedHistory, deep_counts) -> list[int]:
     return out
 
 
+def _refusal_diagnostics(
+    enc: EncodedHistory,
+    history: History,
+    rows,
+    max_signatures: int = 8,
+) -> list[tuple[list[int], list[int]]]:
+    """Per distinct counts signature among ``rows`` (post-auto-close host
+    values ``(counts, tail, hi, lo, tok)``): the linearized prefix and the
+    window-open candidate ops whose outputs that row's state refuses — the
+    failure-diagnostics analog of porcupine's partial-linearization info
+    (main.go:606,627), one report per deepest configuration instead of a
+    single outline."""
+    from ..models.stream import step_set
+
+    ki = enc.keep_index()
+    reports: list[tuple[list[int], list[int]]] = []
+    seen: set[tuple[int, ...]] = set()
+    for counts, tail, hi, lo, tok in rows:
+        counts64 = np.asarray(counts, np.int64)
+        sig = tuple(int(x) for x in counts64)
+        if sig in seen:
+            continue
+        seen.add(sig)
+        state = StreamState(
+            tail=int(tail) & 0xFFFFFFFF,
+            stream_hash=((int(hi) & 0xFFFFFFFF) << 32) | (int(lo) & 0xFFFFFFFF),
+            fencing_token=enc.token_of_id[int(tok)],
+        )
+        nxt, cand = _host_next_cands(enc, counts64)
+        refused = []
+        for c in np.flatnonzero(cand):
+            j = int(nxt[c])
+            op = history.ops[ki[j]]
+            if not step_set([state], op.inp, op.out):
+                refused.append(ki[j])
+        prefix = _deepest_ops(enc, counts64)
+        reports.append((sorted(prefix), sorted(refused)))
+        if len(reports) >= max_signatures:
+            break
+    return reports
+
+
+def _device_refusals(
+    enc: EncodedHistory,
+    history: History | None,
+    frontier: Frontier,
+    sample: int = 256,
+    max_signatures: int = 8,
+) -> list[tuple[list[int], list[int]]]:
+    """Refusal reports from a pre-extinction device frontier (the frontier
+    ``run_search`` hands back on STOP_EMPTY): compact on device, fetch a
+    small row sample, diagnose host-side."""
+    if history is None:
+        return []
+    counts_m, tail_m, hi_m, lo_m, tok_m, n = _compact_rows_device(frontier)
+    m = min(int(n), sample)
+    if m == 0:
+        return []
+    cm, tm, hm, lm, km = device_get(
+        (counts_m[:m], tail_m[:m], hi_m[:m], lo_m[:m], tok_m[:m])
+    )
+    rows = [(cm[i], tm[i], hm[i], lm[i], km[i]) for i in range(m)]
+    return _refusal_diagnostics(enc, history, rows, max_signatures)
+
+
+def _host_row_refusals(
+    enc: EncodedHistory,
+    history: History | None,
+    host: np.ndarray,
+    max_signatures: int = 8,
+) -> list[tuple[list[int], list[int]]]:
+    """Refusal reports from the spill path's host frontier (the final
+    streamed layer's input rows, which all died).  Rows are pre-auto-close;
+    the deterministic closure is applied before diagnosis so reports match
+    the device engine's post-close view."""
+    if history is None:
+        return []
+    c = enc.num_chains
+    rows = []
+    for i in range(min(len(host), 2048)):
+        counts = host[i, :c].astype(np.int64).copy()
+        tail = int(host[i, c]) & 0xFFFFFFFF
+        tok = int(host[i, c + 3])
+        _host_close(enc, counts, tail, tok)
+        rows.append(
+            (counts, host[i, c], host[i, c + 1], host[i, c + 2], tok)
+        )
+    return _refusal_diagnostics(enc, history, rows, max_signatures)
+
+
 def _dedup_rows(mat: np.ndarray, _key_bits: int = 64) -> np.ndarray:
     """Exact row dedup for the spill frontier.
 
@@ -1698,7 +1795,9 @@ def _spill_search(
             if code == STOP_EMPTY:
                 return conclude(
                     CheckResult(
-                        CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+                        CheckOutcome.ILLEGAL,
+                        deepest=_deepest_ops(enc, deep),
+                        refusals=_device_refusals(enc, history, out.frontier),
                     )
                 )
             # STOP_CAPACITY: back to streaming from the returned
@@ -1881,7 +1980,9 @@ def _spill_search(
         if not children:
             return conclude(
                 CheckResult(
-                    CheckOutcome.ILLEGAL, deepest=_deepest_ops(enc, deep)
+                    CheckOutcome.ILLEGAL,
+                    deepest=_deepest_ops(enc, deep),
+                    refusals=_host_row_refusals(enc, history, host),
                 )
             )
         host = _dedup_rows(np.concatenate(children))

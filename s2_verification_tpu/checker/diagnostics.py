@@ -1,0 +1,119 @@
+"""Failure diagnostics: which ops *refuse* to linearize at the deepest
+prefix.
+
+The reference hands ``porcupine.Visualize`` per-op partial-linearization
+info that lets a reader explore where a failed check got stuck
+(golang/s2-porcupine/main.go:606,627).  The engines here record the deepest
+linearized prefix (``CheckResult.deepest``); this module turns that prefix
+into an actionable report — the concrete ops whose outputs are inconsistent
+with every state reachable at the deepest configuration — so the HTML
+artifact can point at the culprit instead of only outlining how far the
+search got.
+
+The deepest set of a sequential client is always a per-chain prefix, so it
+is equivalent to a counts vector; a bounded single-state DFS (same shape as
+the device engine's witness recovery) re-derives one concrete path to that
+configuration, and the path's end state is then tested against every
+window-open candidate op.  Refusal is reported per reached state — exactly
+the device engine's per-row semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..models.stream import INIT_STATE, step_set
+from .entries import History
+
+__all__ = ["deepest_refusals"]
+
+log = logging.getLogger("s2_verification_tpu.diagnostics")
+
+
+def _counts_of_deepest(history: History, deepest: list[int]) -> list[int] | None:
+    """Deepest op set → per-chain prefix lengths; None if not a prefix
+    (malformed input, never expected from an engine)."""
+    ds = set(deepest)
+    counts = []
+    for members in history.chains:
+        k = 0
+        for i, op_idx in enumerate(members):
+            if op_idx in ds:
+                if i != k:
+                    return None
+                k = i + 1
+        counts.append(k)
+    if sum(counts) != len(ds):
+        return None
+    return counts
+
+
+def _next_cands(history: History, counts) -> tuple[dict[int, int], list[int]]:
+    """Window-open candidate chains at a configuration (the host mirror of
+    the engines' candidate rule): each chain's next op, filtered to those
+    whose call precedes every unlinearized op's earliest return."""
+    nxt: dict[int, int] = {}
+    m = None
+    for c, members in enumerate(history.chains):
+        if counts[c] < len(members):
+            j = members[counts[c]]
+            nxt[c] = j
+            r = history.ops[j].ret
+            m = r if m is None else min(m, r)
+    cand = [c for c, j in nxt.items() if m is None or history.ops[j].call < m]
+    return nxt, cand
+
+
+def deepest_refusals(
+    history: History,
+    deepest: list[int],
+    node_budget: int = 200_000,
+) -> tuple[list[int], list[int]] | None:
+    """(deepest prefix ops, ops refusing to linearize there), or None when
+    the prefix cannot be re-derived inside ``node_budget`` DFS nodes."""
+    target = _counts_of_deepest(history, deepest)
+    if target is None:
+        log.warning("deepest set is not a per-chain prefix; no diagnostics")
+        return None
+    tt = tuple(target)
+    start = (0,) * len(history.chains)
+
+    seen = {(start, (INIT_STATE.tail, INIT_STATE.stream_hash, INIT_STATE.fencing_token))}
+    stack = [(start, INIT_STATE)]
+    budget = node_budget
+    goal_state = None
+    while stack:
+        counts_t, state = stack.pop()
+        if counts_t == tt:
+            goal_state = state
+            break
+        nxt, cand = _next_cands(history, counts_t)
+        for c in cand:
+            if counts_t[c] >= tt[c]:
+                continue
+            op = history.ops[nxt[c]]
+            nct = counts_t[:c] + (counts_t[c] + 1,) + counts_t[c + 1 :]
+            for ns in step_set([state], op.inp, op.out):
+                key = (nct, (ns.tail, ns.stream_hash, ns.fencing_token))
+                if key in seen:
+                    continue
+                budget -= 1
+                if budget <= 0:
+                    log.warning(
+                        "refusal diagnostics exhausted the %d-node budget",
+                        node_budget,
+                    )
+                    return None
+                seen.add(key)
+                stack.append((nct, ns))
+    if goal_state is None:
+        log.warning("deepest configuration not re-derivable; no diagnostics")
+        return None
+
+    nxt, cand = _next_cands(history, tt)
+    refused = [
+        nxt[c]
+        for c in cand
+        if not step_set([goal_state], history.ops[nxt[c]].inp, history.ops[nxt[c]].out)
+    ]
+    return sorted(deepest), sorted(refused)

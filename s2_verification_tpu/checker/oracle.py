@@ -43,6 +43,10 @@ class CheckResult:
     linearization: list[int] | None = None
     #: deepest set of linearized op indices reached, for diagnostics/viz
     deepest: list[int] = field(default_factory=list)
+    #: per distinct deepest configuration: (linearized op indices, op
+    #: indices that refused to linearize there) — the failure-diagnostics
+    #: analog of porcupine's partial-linearization info (main.go:606,627)
+    refusals: list[tuple[list[int], list[int]]] = field(default_factory=list)
     #: states consistent with the full linearization, when OK
     final_states: list[StreamState] = field(default_factory=list)
     #: search statistics

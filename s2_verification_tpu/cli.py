@@ -183,6 +183,22 @@ def _cmd_check(args: argparse.Namespace) -> int:
         raise
     dt = time.monotonic() - t0
 
+    if (
+        not args.no_viz
+        and res.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN)
+        and res.deepest
+        and not res.refusals
+    ):
+        # Backends that don't produce refusal reports themselves (oracle,
+        # native, frontier) get them re-derived from the deepest prefix, so
+        # the artifact names the culprit ops whichever engine decided.
+        # (Only the visualization consumes refusals, hence the no_viz gate.)
+        from .checker.diagnostics import deepest_refusals
+
+        report = deepest_refusals(checked, res.deepest)
+        if report is not None:
+            res.refusals = [report]
+
     if not args.no_viz:
         # Always emit the visualization, success or not, like the reference
         # (main.go:608-631): porcupine-outputs/<base>-<unique>.html.

@@ -29,6 +29,7 @@ from ..utils import events as ev
 from ..utils.hashing import record_hash
 from .clock import VirtualClock
 from .fake_s2 import FakeS2Stream, FaultPlan
+from .transport import S2StreamTransport
 from .workloads import Ids, HistorySink, WorkloadConfig, run_client
 
 __all__ = ["CollectConfig", "collect_history", "collect_to_file"]
@@ -61,7 +62,7 @@ def initialize_tail(sink: HistorySink, op_id: int, tail: int, hashes: list[int])
     sink.send(ev.LabeledEvent(ev.AppendSuccess(tail=tail), client_id=0, op_id=op_id))
 
 
-async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent]:
+async def _run(cfg: CollectConfig, stream: S2StreamTransport) -> list[ev.LabeledEvent]:
     sink = HistorySink()
     ids = Ids()
 
@@ -132,7 +133,7 @@ async def _run(cfg: CollectConfig, stream: FakeS2Stream) -> list[ev.LabeledEvent
 
 
 def collect_history(
-    cfg: CollectConfig, stream: FakeS2Stream | None = None
+    cfg: CollectConfig, stream: S2StreamTransport | None = None
 ) -> list[ev.LabeledEvent]:
     """Collect a history in-memory; returns the full event list."""
     if stream is None:
@@ -145,7 +146,7 @@ def collect_history(
 
 def collect_to_file(
     cfg: CollectConfig,
-    stream: FakeS2Stream | None = None,
+    stream: S2StreamTransport | None = None,
     out_dir: str = "./data",
 ) -> str:
     """Collect and write ``<out_dir>/records.<epoch>.jsonl``; returns the path."""

@@ -31,6 +31,18 @@ from dataclasses import dataclass, field
 from ..utils.hashing import record_hash
 from .clock import vsleep
 
+# The client-visible contract types live in the transport seam
+# (re-exported here for compatibility): any transport implementation
+# raises the same taxonomy the collector classifies on.
+from .transport import (
+    AppendAck,
+    AppendConditionFailed,
+    CheckTailError,
+    DefiniteServerError,
+    IndefiniteServerError,
+    ReadError,
+)
+
 log = logging.getLogger("s2_verification_tpu.fake_s2")
 
 __all__ = [
@@ -43,26 +55,6 @@ __all__ = [
     "AppendAck",
     "FakeS2Stream",
 ]
-
-
-class AppendConditionFailed(Exception):
-    """match_seq_num or fencing-token precondition failed (definite)."""
-
-
-class DefiniteServerError(Exception):
-    """Server error with a no-side-effect error code (definite)."""
-
-
-class IndefiniteServerError(Exception):
-    """Ambiguous error: the append may or may not have applied."""
-
-
-class ReadError(Exception):
-    pass
-
-
-class CheckTailError(Exception):
-    pass
 
 
 @dataclass
@@ -87,12 +79,6 @@ class FaultPlan:
             p_check_tail_fail=intensity * 0.5,
             max_latency=max_latency,
         )
-
-
-@dataclass
-class AppendAck:
-    #: Sequence number one past the last appended record (ack.end.seq_num).
-    tail: int
 
 
 @dataclass

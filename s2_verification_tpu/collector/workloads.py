@@ -35,13 +35,13 @@ from dataclasses import dataclass, field
 from ..utils import events as ev
 from ..utils.hashing import record_hash, stream_hash_of_bodies
 from .clock import vsleep
-from .fake_s2 import (
+from .transport import (
     AppendConditionFailed,
     CheckTailError,
     DefiniteServerError,
-    FakeS2Stream,
     IndefiniteServerError,
     ReadError,
+    S2StreamTransport,
 )
 
 __all__ = ["WorkloadConfig", "Ids", "HistorySink", "run_client", "WORKFLOWS"]
@@ -120,7 +120,7 @@ def _generate_token(rng: random.Random, n: int = 6) -> str:
 
 @dataclass
 class _ClientCtx:
-    stream: FakeS2Stream
+    stream: S2StreamTransport
     sink: HistorySink
     ids: Ids
     rng: random.Random
@@ -239,7 +239,7 @@ async def _rotate_client_id(ctx: _ClientCtx) -> int | None:
 
 
 async def run_client(
-    stream: FakeS2Stream,
+    stream: S2StreamTransport,
     sink: HistorySink,
     ids: Ids,
     rng: random.Random,

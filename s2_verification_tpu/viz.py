@@ -46,6 +46,7 @@ h1 { font-size: 17px; margin: 0 0 2px; }
 .op .ord { position: absolute; top: -1px; left: 2px; font-size: 10px;
            font-weight: 700; color: #19306b; }
 .op.linearized { outline: 2px solid #4164c9; }
+.op.refused { outline: 2px dashed #a12622; }
 .legend { margin: 14px 0 0; color: #5f6672; }
 .legend span.chip { display: inline-block; width: 12px; height: 12px;
                     border-radius: 3px; margin: 0 4px 0 12px;
@@ -99,6 +100,13 @@ def render_html(
         for pos, idx in enumerate(result.linearization):
             order_by_opid[checked.ops[idx].op_id] = pos + 1
     deepest_opids = {checked.ops[i].op_id for i in (result.deepest or [])}
+    # Ops that refused to linearize at the deepest configuration(s) — the
+    # culprits of a failed check (porcupine info analog, main.go:606,627).
+    refused_opids = {
+        checked.ops[i].op_id
+        for _, refused in (result.refusals or [])
+        for i in refused
+    }
 
     n_events = max((op.ret for op in history.ops if not op.pending), default=1)
     n_events = max(n_events, max((op.call for op in history.ops), default=0) + 1)
@@ -119,6 +127,8 @@ def render_html(
             classes = ["op", _op_class(op)]
             if ordinal is not None or op.op_id in deepest_opids:
                 classes.append("linearized")
+            if op.op_id in refused_opids:
+                classes.append("refused")
             tip = (
                 f"op {op.op_id} (client {op.client_id})\n"
                 f"{describe_operation(op.inp, op.out)}\n"
@@ -127,6 +137,8 @@ def render_html(
             )
             if ordinal is not None:
                 tip += f"\nlinearized at position {ordinal}"
+            if op.op_id in refused_opids:
+                tip += "\nREFUSED to linearize at the deepest prefix"
             ord_html = f'<span class="ord">{ordinal}</span>' if ordinal else ""
             tip_attr = html.escape(tip, quote=True).replace("\n", "&#10;")
             bars.append(
@@ -153,7 +165,9 @@ def render_html(
         '<span class="chip" style="background:#ffd488;border-style:dashed"></span>'
         "indefinite/pending"
         '<span class="chip" style="background:#fff;outline:2px solid #4164c9">'
-        "</span>linearized</div>",
+        "</span>linearized"
+        '<span class="chip" style="background:#fff;outline:2px dashed #a12622">'
+        "</span>refused</div>",
     ]
     if result.ok and result.final_states:
         states = ", ".join(
@@ -170,6 +184,15 @@ def render_html(
             f"{len(result.deepest)} / "
             f"{sum(1 for o in checked.ops)} ops (outlined)</div>"
         )
+        if refused_opids:
+            ids = ", ".join(str(i) for i in sorted(refused_opids))
+            n_cfg = len(result.refusals)
+            pieces.append(
+                f'<div class="final">refusing to linearize at '
+                f"{n_cfg} deepest configuration{'s' if n_cfg != 1 else ''}: "
+                f"op{'s' if len(refused_opids) != 1 else ''} "
+                f"<code>{html.escape(ids)}</code> (red dashed outline)</div>"
+            )
     body = "\n".join(pieces)
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"

@@ -195,3 +195,49 @@ def test_stream_reuse_across_collections_does_not_deadlock():
     from s2_verification_tpu.checker.entries import prepare
     from s2_verification_tpu.checker.oracle import check
     assert check(prepare(second)).ok
+
+
+def test_transport_seam_structural():
+    # VERDICT r2 #8: the workloads are typed against the transport seam;
+    # the fake satisfies it structurally (no inheritance), so a
+    # network-backed implementation is a driver swap, not surgery.
+    from s2_verification_tpu.collector.transport import S2StreamTransport
+
+    assert isinstance(FakeS2Stream(), S2StreamTransport)
+
+
+def test_alternative_transport_drives_collection():
+    # A different class implementing the protocol (here a delegating
+    # wrapper standing in for a real-endpoint client) runs the full
+    # collection pipeline unchanged and still yields a linearizable
+    # history.
+    inner = FakeS2Stream(
+        rng=random.Random(0xB0B),
+        faults=FaultPlan.chaos(intensity=0.25, max_latency=0.001),
+    )
+
+    class WrapperTransport:
+        clock = None
+
+        async def append(self, bodies, **kw):
+            inner.clock = self.clock
+            return await inner.append(bodies, **kw)
+
+        async def read_all(self):
+            inner.clock = self.clock
+            return await inner.read_all()
+
+        async def check_tail(self):
+            inner.clock = self.clock
+            return await inner.check_tail()
+
+        def snapshot_bodies(self):
+            return inner.snapshot_bodies()
+
+    from s2_verification_tpu.collector.transport import S2StreamTransport
+
+    wrapper = WrapperTransport()
+    assert isinstance(wrapper, S2StreamTransport)
+    events = collect_history(cfg(workflow="match-seq-num"), stream=wrapper)
+    assert events
+    assert check_events(events).outcome == CheckOutcome.OK

@@ -350,6 +350,33 @@ def test_spill_adversarial_conclusive():
     )
     assert res.outcome == CheckOutcome.ILLEGAL
     assert res.deepest  # diagnostics survive the spill
+    # Refusal reports survive the spill too, and the corrupted pinning
+    # read is named as a culprit at some deepest configuration.
+    assert res.refusals
+    read_idx = [i for i, o in enumerate(hist.ops) if o.inp.input_type == 1]
+    assert any(
+        set(read_idx) & set(refused) for _, refused in res.refusals
+    )
+
+
+def test_device_refusals_name_the_culprit():
+    # VERDICT r2 #5: on ILLEGAL, the device engine reports the deepest
+    # configurations' refusing ops (per distinct counts signature), and
+    # the corrupted pinning read is among them.
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(4, batch=4, seed=3, unsatisfiable=True))
+    res = check_device(
+        hist, max_frontier=4096, start_frontier=16, beam=False, witness=False
+    )
+    assert res.outcome == CheckOutcome.ILLEGAL
+    assert res.refusals
+    read_idx = {i for i, o in enumerate(hist.ops) if o.inp.input_type == 1}
+    assert any(read_idx & set(refused) for _, refused in res.refusals)
+    # Each report's prefix is sane: a subset of ops, disjoint from refused.
+    for prefix, refused in res.refusals:
+        assert set(prefix).isdisjoint(refused)
+        assert all(0 <= i < len(hist.ops) for i in prefix + refused)
 
 
 def test_spill_final_states_match_incore():
