@@ -319,7 +319,9 @@ def _fast_layer(tables: SearchTables, frontier: Frontier):
     indefinite append (single successor).  The child needs no dedup or
     compaction, so the layer skips the frontier-wide hash table — the
     dominant cost on the long sequential stretches of collector histories.
-    Return signature matches :func:`_expand_layer`.
+    Return signature matches :func:`_expand_layer`.  Used when the
+    witness log is on (one log row per layer); log-free runs take
+    :func:`_fast_multi` instead.
     """
     idx = jnp.argmax(frontier.valid)
     counts = frontier.counts[idx]
@@ -337,6 +339,7 @@ def _fast_layer(tables: SearchTables, frontier: Frontier):
         valid=frontier.valid.at[idx].set(va),
     )
     f = frontier.valid.shape[0]
+    c = frontier.counts.shape[1]
     wparent = jnp.zeros(f, _I32).at[idx].set(idx.astype(_I32))
     wop = jnp.full(f, -1, _I32).at[idx].set(jnp.where(va, o * 2, -1))
     return (
@@ -347,6 +350,98 @@ def _fast_layer(tables: SearchTables, frontier: Frontier):
         jnp.ones((), _I32),
         wparent,
         wop,
+        jnp.ones((), _I32),
+        jnp.zeros(c, _I32),
+        jnp.zeros((), bool),
+    )
+
+
+def _fast_multi(tables: SearchTables, budget, frontier: Frontier):
+    """A RUN of forced steps on the unique live row, inside ONE layer.
+
+    Entry precondition is :func:`_fast_layer`'s, checked by the caller for
+    the first step; the inner ``while_loop`` keeps stepping while the row
+    stays alive, its candidate window stays single-chain, and the next op
+    is not an indefinite append — consuming a whole sequential stretch of
+    a collector history per outer-loop iteration, so the full-frontier
+    auto-close and accept sweeps are paid once per *stretch* instead of
+    once per *op*.  Only used when the witness log is off (a multi-op
+    layer has no per-layer log row; OK verdicts recover their witness
+    from the accept counts via :func:`_recover_witness_bounded`).
+
+    Returns the :func:`_expand_layer` 9-tuple; the 8th element is the
+    number of ops consumed (the layer counter advances by it) and the 9th
+    the deepest counts actually reached (a row that dies mid-stretch is
+    deeper than the stretch's entry counts — the diagnostics must not
+    under-report it).
+    """
+    f = frontier.valid.shape[0]
+    idx = jnp.argmax(frontier.valid)
+
+    def nxt_op(counts):
+        nxt, cand = _next_and_cands(tables, counts)
+        chain = jnp.argmax(cand)
+        return nxt[chain], chain, cand.sum() == 1
+
+    def cond(st):
+        counts, tail, hi, lo, tok, valid, n = st
+        o, _, single = nxt_op(counts)
+        return valid & single & ~tables.is_indef[o] & (n < budget)
+
+    def step(st):
+        counts, tail, hi, lo, tok, valid, n = st
+        o, chain, _ = nxt_op(counts)
+        sa, va, _sb, _vb = step_kernel(
+            tables.ops, o, DeviceState(tail, hi, lo, tok)
+        )
+        # A refused op is NOT part of the linearized prefix: on failure
+        # keep the pre-attempt counts AND state, so the exit carry is the
+        # exact death-point configuration — the refusal diagnostics replay
+        # from it (a stretch-entry snapshot would name no culprit).
+        new = lambda good, old: jnp.where(va, good, old)
+        return (
+            new(counts.at[chain].add(1), counts),
+            new(sa.tail, tail),
+            new(sa.hash_hi, hi),
+            new(sa.hash_lo, lo),
+            new(sa.token, tok),
+            va,
+            n + 1,
+        )
+
+    st = (
+        frontier.counts[idx],
+        frontier.tail[idx],
+        frontier.hi[idx],
+        frontier.lo[idx],
+        frontier.tok[idx],
+        jnp.ones((), bool),
+        jnp.zeros((), _I32),
+    )
+    counts, tail, hi, lo, tok, valid, n = lax.while_loop(cond, step, st)
+    # The idx row stays marked valid even when it died: on STOP_EMPTY the
+    # driver's refusal diagnostics need the death-point configuration (the
+    # 10th return element routes this frontier to them); n_unique carries
+    # the real liveness, so the stop logic is unaffected.
+    children = Frontier(
+        counts=frontier.counts.at[idx].set(counts),
+        tail=frontier.tail.at[idx].set(tail),
+        hi=frontier.hi.at[idx].set(hi),
+        lo=frontier.lo.at[idx].set(lo),
+        tok=frontier.tok.at[idx].set(tok),
+        valid=frontier.valid,
+    )
+    return (
+        children,
+        jnp.zeros((), bool),
+        jnp.zeros((), bool),
+        valid.astype(_I32),
+        n,
+        jnp.zeros(f, _I32),
+        jnp.full(f, -1, _I32),
+        n,
+        counts,
+        jnp.ones((), bool),
     )
 
 
@@ -375,10 +470,16 @@ def _zob_fold(zob, counts):
 
 
 def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool):
-    """Expand + dedup + compact one layer.  Returns (children, pruned,
-    overflow, n_unique, expanded, wparent, wop) — the last two are the
-    per-child witness-log row: parent row index and op*2+branch (-1 =
-    no child), used to walk an accepting path back for the linearization."""
+    """Expand + dedup + compact one layer.  Returns the 10-tuple
+    (children, pruned, overflow, n_unique, expanded, wparent, wop,
+    n_steps, deep_row, children_are_diag): wparent/wop are the per-child
+    witness-log row (parent row index and op*2+branch, -1 = no child),
+    used to walk an accepting path back for the linearization; n_steps is
+    the ops consumed (1 here; a fast stretch consumes more), deep_row a
+    deeper-than-pre-expansion counts candidate (zeros here), and
+    children_are_diag whether, on extinction, ``children`` rather than the
+    pre-expansion frontier holds the diagnosable configuration (False
+    here)."""
     f, c = frontier.counts.shape
     ops = tables.ops
 
@@ -524,7 +625,18 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
         valid=valid_next,
     )
     expanded = cand.sum()
-    return children, pruned, jnp.zeros((), bool), n_unique, expanded, wparent, wop
+    return (
+        children,
+        pruned,
+        jnp.zeros((), bool),
+        n_unique,
+        expanded,
+        wparent,
+        wop,
+        jnp.ones((), _I32),
+        jnp.zeros(c, _I32),
+        jnp.zeros((), bool),
+    )
 
 
 @partial(jax.jit, static_argnames=("allow_prune", "log_layers"))
@@ -562,14 +674,23 @@ def run_search(
         accept_any = acc_row.any()
 
         def do_expand(fr):
+            # Log-free runs take the multi-step fast path (whole forced
+            # stretches per layer); logged runs must keep one op per layer
+            # so the witness log rows stay walkable.
+            fast = (
+                partial(_fast_layer, tables)
+                if log_layers
+                else partial(_fast_multi, tables, max_layers - carry.layers)
+            )
             return lax.cond(
                 fastable,
-                partial(_fast_layer, tables),
+                fast,
                 partial(_expand_layer, tables, allow_prune=allow_prune),
                 fr,
             )
 
         f = frontier.valid.shape[0]
+        c = frontier.counts.shape[1]
 
         def no_expand(fr):
             zero = jnp.zeros((), _I32)
@@ -581,6 +702,9 @@ def run_search(
                 zero,
                 jnp.zeros(f, _I32),
                 jnp.full(f, -1, _I32),
+                jnp.ones((), _I32),
+                jnp.zeros(c, _I32),
+                jnp.zeros((), bool),
             )
 
         # Fast path: a lone live row with a single-chain candidate window
@@ -595,9 +719,18 @@ def run_search(
             & ~tables.is_indef[op1]
         )
 
-        children, pruned, overflow, n_unique, expanded, wparent, wop = lax.cond(
-            accept_any, no_expand, do_expand, closed
-        )
+        (
+            children,
+            pruned,
+            overflow,
+            n_unique,
+            expanded,
+            wparent,
+            wop,
+            n_steps,
+            deep_row,
+            children_are_diag,
+        ) = lax.cond(accept_any, no_expand, do_expand, closed)
         empty = ~accept_any & (n_unique == 0)
         need_cap = (not allow_prune) & (pruned | overflow)
         stop = jnp.where(
@@ -607,9 +740,12 @@ def run_search(
         ).astype(_I32)
 
         # On accept/capacity the caller needs the pre-expansion frontier to
-        # conclude or resume; on extinction it needs the same thing for
-        # refusal diagnostics (which rows died, and on which ops).
-        resume = accept_any | need_cap | empty
+        # conclude or resume; on extinction it needs the deepest diagnosable
+        # frontier for refusal diagnostics — the pre-expansion rows for a
+        # batched layer (their candidates all refused), but the death-POINT
+        # configuration for a multi-op fast stretch (the entry snapshot
+        # would be many ops shallower and name no culprit).
+        resume = accept_any | need_cap | (empty & ~children_are_diag)
         nxt = jax.tree.map(
             lambda a, b: jnp.where(
                 resume.reshape((-1,) + (1,) * (a.ndim - 1)), a, b
@@ -632,11 +768,18 @@ def run_search(
             new_wop = lax.dynamic_update_index_in_dim(carry.wop, wop, li, 0)
         else:
             new_wparent, new_wop = carry.wparent, carry.wop
+        # A multi-step fast layer may die mid-stretch: its deepest reached
+        # counts (deep_row) beat the pre-expansion snapshot.
+        deep_new = jnp.where(
+            deep_row.sum() > closed.counts[live_idx].sum(),
+            deep_row,
+            closed.counts[live_idx],
+        )
         return RunOut(
             frontier=nxt,
             stop_code=stop,
             accept_idx=jnp.argmax(acc_row).astype(_I32),
-            layers=carry.layers + committed.astype(_I32),
+            layers=carry.layers + jnp.where(committed, n_steps, 0),
             pruned_ever=carry.pruned_ever | pruned,
             overflow_ever=carry.overflow_ever | overflow,
             max_live=jnp.maximum(
@@ -648,7 +791,7 @@ def run_search(
             auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
             expanded=carry.expanded
             + jnp.where(committed, expanded, jnp.zeros((), _I32)),
-            deep_counts=jnp.where(committed, closed.counts[live_idx], carry.deep_counts),
+            deep_counts=jnp.where(committed, deep_new, carry.deep_counts),
             want=jnp.where(need_cap, n_unique, carry.want),
             wparent=new_wparent,
             wop=new_wop,
@@ -827,7 +970,7 @@ def check_device(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
     witness: bool = True,
-    witness_max_frontier: int = 4096,
+    witness_max_frontier: int = 0,
     spill: bool = False,
     spill_host_cap: int = 1 << 26,
 ) -> CheckResult:
@@ -851,16 +994,20 @@ def check_device(
     is resumed from, and a conclusive verdict removes it.  A new capability
     over the reference, whose checking is one-shot in-memory (SURVEY.md §5).
 
-    ``witness``: record a per-layer (parent row, op, branch) log inside the
-    compiled loop and, on accept, walk it backwards + replay it forwards to
-    recover a concrete linearization (the analog of the linearization info
-    ``porcupine.CheckEventsVerbose`` hands ``Visualize``, main.go:605-631).
-    Logging is dropped — the verdict is unaffected — once the frontier
-    escalates past ``witness_max_frontier`` (the log costs O(layers x F)
-    device memory) or when resuming from a checkpoint (earlier layers'
-    logs are gone); an OK verdict then recovers a linearization anyway
-    via the counts-bounded host re-search (:func:`_recover_witness_bounded`),
-    so a requested witness survives every scale the engine decides at.
+    ``witness``: produce a concrete linearization on OK (the analog of the
+    linearization info ``porcupine.CheckEventsVerbose`` hands
+    ``Visualize``, main.go:605-631).  The default mechanism is the
+    counts-bounded host re-search (:func:`_recover_witness_bounded`) run
+    once at accept — it adds nothing to the compiled search, survives
+    every scale the engine decides at (huge frontiers, checkpoint resume,
+    spill), and frees the loop to take multi-op fast layers
+    (:func:`_fast_multi`), which is worth ~3x steady-state on collector
+    histories.  Setting ``witness_max_frontier > 0`` instead records a
+    per-layer (parent row, op, branch) log inside the compiled loop while
+    the frontier fits the cap and walks it backwards at accept — the
+    exact search path, at the cost of one-op-per-layer execution and
+    O(layers x F) device memory; past the cap (or on checkpoint resume)
+    the log is dropped and recovery takes over anyway.
 
     ``spill=True`` (exhaustive mode only): when the frontier outgrows
     ``max_frontier``, spill it to host RAM and stream slabs through the
@@ -1014,11 +1161,12 @@ def check_device(
     while True:
         allow_prune = beam and f >= f_cap
         if witness and f > witness_max_frontier:
-            log.debug(
-                "witness log dropped: frontier %d exceeds witness cap %d",
-                f,
-                witness_max_frontier,
-            )
+            if witness_max_frontier > 0:
+                log.debug(
+                    "witness log dropped: frontier %d exceeds witness cap %d",
+                    f,
+                    witness_max_frontier,
+                )
             witness = False
             wlogs = []
         layers_budget = cap_layers - stats.layers
@@ -2012,7 +2160,7 @@ def check_device_auto(
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
     witness: bool = True,
-    witness_max_frontier: int = 4096,
+    witness_max_frontier: int = 0,
     spill: bool = True,
     spill_host_cap: int = 1 << 26,
 ) -> CheckResult:

@@ -233,8 +233,13 @@ def test_device_witness_on_collected_histories(workflow):
     )
     hist = prepare(events)
     # start_frontier=2 forces capacity escalations mid-run, exercising the
-    # witness log across segment boundaries and _regrow row preservation.
-    res = check_device(hist, max_frontier=4096, start_frontier=2, beam=False)
+    # witness log across segment boundaries and _regrow row preservation
+    # (witness_max_frontier>0 opts into the device log path; the default
+    # is counts-bounded recovery, covered by the other witness tests).
+    res = check_device(
+        hist, max_frontier=4096, start_frontier=2, beam=False,
+        witness_max_frontier=4096,
+    )
     assert res.outcome == CheckOutcome.OK
     assert res.linearization is not None
     _assert_valid_linearization(hist, res.linearization)
@@ -294,6 +299,57 @@ def test_spill_witness_recovered():
     assert res.stats.max_frontier > 32
     assert res.linearization is not None
     _assert_valid_linearization(hist, res.linearization)
+
+
+def test_refusals_survive_fast_stretch_death():
+    # Regression: a row that dies mid-stretch inside the multi-op fast
+    # layer (_fast_multi) must yield refusal diagnostics at the DEATH
+    # POINT, not at the stretch entry.  Shape: brief concurrency (a
+    # returned ambiguous append, pinned by a check-tail) collapsing to a
+    # single row, then a forced sequential stretch of successful appends
+    # ending in a read whose hash is corrupted — the read must be named.
+    from s2_verification_tpu.utils import events as ev
+    from s2_verification_tpu.utils.hashing import fold_record_hashes
+
+    events = [
+        ev.LabeledEvent(
+            ev.AppendStart(num_records=1, record_hashes=(11,)),
+            client_id=1,
+            op_id=0,
+        ),
+        ev.LabeledEvent(ev.AppendIndefiniteFailure(), client_id=1, op_id=0),
+        ev.LabeledEvent(ev.CheckTailStart(), client_id=2, op_id=1),
+        ev.LabeledEvent(ev.CheckTailSuccess(tail=1), client_id=2, op_id=1),
+    ]
+    h = fold_record_hashes(0, [11])
+    for i in range(6):
+        events.append(
+            ev.LabeledEvent(
+                ev.AppendStart(num_records=1, record_hashes=(100 + i,)),
+                client_id=3,
+                op_id=2 + i,
+            )
+        )
+        events.append(
+            ev.LabeledEvent(ev.AppendSuccess(tail=2 + i), client_id=3, op_id=2 + i)
+        )
+        h = fold_record_hashes(h, [100 + i])
+    events.append(ev.LabeledEvent(ev.ReadStart(), client_id=3, op_id=8))
+    events.append(
+        ev.LabeledEvent(
+            ev.ReadSuccess(tail=7, stream_hash=h ^ 1), client_id=3, op_id=8
+        )
+    )
+    hist = prepare(events)
+    res = check_device(hist, max_frontier=64, start_frontier=16, beam=False)
+    assert res.outcome == CheckOutcome.ILLEGAL
+    read_idx = {i for i, o in enumerate(hist.ops) if o.inp.input_type == 1}
+    assert res.refusals, "no refusal report after a fast-stretch death"
+    assert any(read_idx & set(refused) for _, refused in res.refusals), (
+        f"culprit read not named: {res.refusals}"
+    )
+    # The deepest prefix must reach the death point (everything but the read).
+    assert max(len(p) for p, _ in res.refusals) == len(hist.ops) - 1
 
 
 def test_spill_matches_oracle_on_random_histories():
