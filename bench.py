@@ -107,11 +107,12 @@ def _cpu_fallback(note: str) -> int:
     XLA:CPU backend instead of reporting a dead zero.  The stderr note keeps
     the headline honest; S2VTPU_BENCH_NO_FALLBACK=1 restores the zero line.
 
-    The child is bounded (the driver must never wedge on a bench), skips the
-    adversarial line by default (that regime is sized for the chip, not host
-    cores — same reasoning as mesh_scaling's CPU shrink), and the parent
-    guarantees the one-JSON-line stdout contract even if the child dies
-    before printing it."""
+    The child is bounded (the driver must never wedge on a bench) and the
+    parent guarantees the one-JSON-line stdout contract even if the child
+    dies before printing it.  The adversarial line RUNS in the fallback
+    (since round 3 the host-cores engine decides k=10 in well under a
+    minute steady-state, so the north-star regime is measurable without
+    the chip); S2VTPU_BENCH_SKIP_ADV=1 restores the skip."""
     if os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1" or os.environ.get(
         "S2VTPU_BENCH_NO_FALLBACK"
     ) == "1":
@@ -123,7 +124,6 @@ def _cpu_fallback(note: str) -> int:
     env = dict(os.environ)
     env["S2VTPU_BENCH_CPU_CHILD"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
-    env.setdefault("S2VTPU_BENCH_SKIP_ADV", "1")
     timeout_s = float(os.environ.get("S2VTPU_BENCH_FALLBACK_TIMEOUT_S", "1800"))
     try:
         proc = subprocess.run(
@@ -133,9 +133,10 @@ def _cpu_fallback(note: str) -> int:
             timeout=timeout_s,
         )
     except subprocess.TimeoutExpired as exc:
-        # The child may have printed the headline line already (e.g. a
-        # user-forced adversarial stage overran the budget) — a captured
-        # valid measurement must not become a zero.
+        # The child may have printed the headline line already (the
+        # adversarial stage, which runs by default in the fallback, can
+        # overrun the budget on a slow host) — a captured valid
+        # measurement must not become a zero.
         outtxt = (exc.stdout or b"").decode(errors="replace")
         if '"metric"' in outtxt:
             print(
@@ -154,7 +155,17 @@ def _cpu_fallback(note: str) -> int:
         )
     sys.stdout.write(outtxt)
     sys.stdout.flush()
-    return proc.returncode
+    # The headline line exists, so the run measured something; a child that
+    # then died in the auxiliary adversarial stage (e.g. OOM at k=10) must
+    # not turn a captured measurement into a failure — same rule as the
+    # timeout branch above and north_star's own try/except.
+    if proc.returncode != 0:
+        print(
+            f"# CPU fallback child exited rc={proc.returncode} after the "
+            "headline line; keeping it",
+            file=sys.stderr,
+        )
+    return 0
 
 
 def make_bench_history(workflow: str, clients: int, ops: int, seed: int):

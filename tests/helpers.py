@@ -90,3 +90,22 @@ class H:
 
 def fold(hashes, start=0):
     return fold_record_hashes(start, hashes)
+
+
+def assert_valid_linearization(hist, order):
+    """Independent witness validation: the order must cover every op exactly
+    once, extend the real-time partial order (A.ret < B.call => A before B),
+    and drive a non-empty candidate-state set through every step."""
+    from s2_verification_tpu.models.stream import INIT_STATE, step_set
+
+    ops = hist.ops
+    assert sorted(order) == list(range(len(ops)))
+    pos = {j: i for i, j in enumerate(order)}
+    for a in ops:
+        for b in ops:
+            if a.ret < b.call:
+                assert pos[a.index] < pos[b.index], (a.index, b.index)
+    states = [INIT_STATE]
+    for j in order:
+        states = step_set(states, ops[j].inp, ops[j].out)
+        assert states, f"empty state set linearizing op {j}"

@@ -98,6 +98,14 @@ def test_resume_from_snapshot(hist, tmp_path):
     )
     assert res.outcome == want
     assert not os.path.exists(ck)
+    if res.outcome.name == "OK":
+        # A resumed run has no witness log for the pre-preemption layers;
+        # the counts-bounded recovery must still produce a valid
+        # linearization (VERDICT r2 #2).
+        from helpers import assert_valid_linearization as _assert_valid_linearization
+
+        assert res.linearization is not None
+        _assert_valid_linearization(hist, res.linearization)
 
 
 def test_beam_snapshot_cannot_resume_exhaustive(hist, tmp_path):

@@ -130,7 +130,7 @@ def test_frontier_witness_is_valid():
     # non-empty.
     import random
 
-    from test_device import _assert_valid_linearization
+    from helpers import assert_valid_linearization as _assert_valid_linearization
     from test_oracle_bruteforce import random_history
 
     rng = random.Random(0xF17)
