@@ -186,16 +186,17 @@ def _cmd_check(args: argparse.Namespace) -> int:
     if (
         not args.no_viz
         and res.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN)
-        and res.deepest
         and not res.refusals
     ):
         # Backends that don't produce refusal reports themselves (oracle,
-        # native, frontier) get them re-derived from the deepest prefix, so
-        # the artifact names the culprit ops whichever engine decided.
-        # (Only the visualization consumes refusals, hence the no_viz gate.)
+        # native, frontier) get them re-derived from the deepest prefix
+        # (an immediate failure's prefix is empty — the culprit refuses
+        # from the initial state and must still be named), so the artifact
+        # names the culprit ops whichever engine decided.  (Only the
+        # visualization consumes refusals, hence the no_viz gate.)
         from .checker.diagnostics import deepest_refusals
 
-        report = deepest_refusals(checked, res.deepest)
+        report = deepest_refusals(checked, res.deepest or [])
         if report is not None:
             res.refusals = [report]
 

@@ -175,15 +175,18 @@ def render_html(
             for s in result.final_states
         )
         pieces.append(f'<div class="final">final states: {states}</div>')
-    elif result.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN) and result.deepest:
+    elif result.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN):
         # Partial-linearization outline, like porcupine.Visualize draws for
         # failed checks (main.go:608-631) — also for inconclusive runs
         # (budget or beam exhaustion), which the reference cannot produce.
-        pieces.append(
-            f'<div class="final">deepest linearized prefix: '
-            f"{len(result.deepest)} / "
-            f"{sum(1 for o in checked.ops)} ops (outlined)</div>"
-        )
+        # An immediate failure has an EMPTY deepest prefix; the refusal
+        # report below still names the culprit then.
+        if result.deepest:
+            pieces.append(
+                f'<div class="final">deepest linearized prefix: '
+                f"{len(result.deepest)} / "
+                f"{sum(1 for o in checked.ops)} ops (outlined)</div>"
+            )
         if refused_opids:
             ids = ", ".join(str(i) for i in sorted(refused_opids))
             n_cfg = len(result.refusals)
@@ -192,6 +195,21 @@ def render_html(
                 f"{n_cfg} deepest configuration{'s' if n_cfg != 1 else ''}: "
                 f"op{'s' if len(refused_opids) != 1 else ''} "
                 f"<code>{html.escape(ids)}</code> (red dashed outline)</div>"
+            )
+            # Per-configuration detail (the explorable partial-linearization
+            # info porcupine's artifact exposes, main.go:606,627).
+            items = []
+            for prefix, refused in result.refusals:
+                r_ids = ", ".join(
+                    str(checked.ops[i].op_id) for i in sorted(refused)
+                )
+                items.append(
+                    f"<li>{len(prefix)} / {len(checked.ops)} ops linearized; "
+                    f"refused: <code>{html.escape(r_ids) or '—'}</code></li>"
+                )
+            pieces.append(
+                f'<div class="final">per configuration:<ul>'
+                f'{"".join(items)}</ul></div>'
             )
     body = "\n".join(pieces)
     return (

@@ -324,3 +324,36 @@ def test_auto_time_budget_zero_never_touches_device(history_path, monkeypatch):
         ]
     )
     assert rc == 0
+
+
+def test_immediate_failure_still_names_culprit(tmp_path):
+    # A history whose very first op refuses from the initial state has an
+    # EMPTY deepest prefix; the artifact must still name the culprit.
+    path = tmp_path / "first.jsonl"
+    with open(path, "w") as f:
+        ev.write_history(
+            [
+                ev.LabeledEvent(ev.ReadStart(), client_id=1, op_id=0),
+                ev.LabeledEvent(
+                    ev.ReadSuccess(tail=5, stream_hash=123), client_id=1, op_id=0
+                ),
+            ],
+            f,
+        )
+    rc = main(
+        [
+            "check",
+            "-file",
+            str(path),
+            "-backend",
+            "oracle",
+            "-out-dir",
+            str(tmp_path / "v"),
+        ]
+    )
+    assert rc == 1
+    import re
+
+    html_text = next((tmp_path / "v").glob("*.html")).read_text()
+    assert re.search(r'class="op [^"]*refused', html_text)
+    assert "refusing to linearize" in html_text
