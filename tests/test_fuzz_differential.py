@@ -1,0 +1,100 @@
+"""Cross-engine differential fuzz: oracle vs native vs frontier vs device.
+
+One generator, four engines, every verdict compared; OK witnesses are
+validated independently and ILLEGAL verdicts must name at least one
+refusing op via the CLI's diagnostics path.  The default trial count is
+CI-sized; crank S2VTPU_FUZZ_TRIALS up for a deep soak (the reference's
+Antithesis role, run locally).
+"""
+
+import os
+import random
+
+from helpers import assert_valid_linearization
+from s2_verification_tpu.checker.device import check_device
+from s2_verification_tpu.checker.diagnostics import deepest_refusals
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from test_oracle_bruteforce import random_history
+
+TRIALS = int(os.environ.get("S2VTPU_FUZZ_TRIALS", "25"))
+
+
+def _native_or_none(hist):
+    from s2_verification_tpu.checker.native import NativeUnavailable, check_native
+
+    try:
+        return check_native(hist)
+    except NativeUnavailable:
+        return None
+
+
+def _tamper(events, rng):
+    """Flip one successful read's hash (or tail) so the history lies."""
+    from s2_verification_tpu.utils.events import (
+        CheckTailSuccess,
+        LabeledEvent,
+        ReadSuccess,
+    )
+
+    idxs = [
+        i
+        for i, e in enumerate(events)
+        if isinstance(e.event, (ReadSuccess, CheckTailSuccess))
+    ]
+    if not idxs:
+        return None
+    i = rng.choice(idxs)
+    e = events[i]
+    if isinstance(e.event, ReadSuccess):
+        new = ReadSuccess(
+            tail=e.event.tail, stream_hash=e.event.stream_hash ^ 1
+        )
+    else:
+        new = CheckTailSuccess(tail=e.event.tail + 1)
+    out = list(events)
+    out[i] = LabeledEvent(new, e.client_id, e.op_id)
+    return out
+
+
+def test_four_engines_agree_with_artifacts():
+    rng = random.Random(0xF0221)
+    oks = illegals = 0
+    for trial in range(TRIALS):
+        h = random_history(rng)
+        events = h.events
+        if trial % 2 == 1:
+            # The simulated service is sequential, so untampered histories
+            # are all linearizable; flip an observation to exercise the
+            # ILLEGAL side (may still be OK if an ambiguous branch covers
+            # the lie — the engines must simply keep agreeing).
+            events = _tamper(events, rng) or events
+        hist = prepare(events)
+        want = check(hist)
+        frontier = check_frontier(hist)
+        device = check_device(
+            hist, max_frontier=256, start_frontier=16, beam=False
+        )
+        assert frontier.outcome == want.outcome, f"trial {trial}: frontier"
+        assert device.outcome == want.outcome, f"trial {trial}: device"
+        native = _native_or_none(hist)
+        if native is not None:
+            assert native.outcome == want.outcome, f"trial {trial}: native"
+
+        if want.outcome == CheckOutcome.OK:
+            oks += 1
+            for name, res in (("oracle", want), ("device", device)):
+                assert res.linearization is not None, f"trial {trial}: {name}"
+                assert_valid_linearization(hist, res.linearization)
+        elif want.outcome == CheckOutcome.ILLEGAL:
+            illegals += 1
+            # The device engine reports refusals directly; the generic
+            # re-derivation must work for the host engines' artifacts.
+            assert device.refusals, f"trial {trial}: device refusals"
+            report = deepest_refusals(hist, want.deepest or [])
+            assert report is not None, f"trial {trial}: re-derivation"
+            _, refused = report
+            assert refused, f"trial {trial}: no culprit named"
+    # The generator must exercise both verdicts, else the sweep is vacuous.
+    assert oks >= 3 and illegals >= 3, (oks, illegals)
