@@ -3,13 +3,16 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast bench clean
+.PHONY: all native test test-fast fuzz bench clean
 
 all: native
 
 # The native C++ checker (the reference's compiled-Go/porcupine analog).
 native:
 	$(MAKE) -C native
+
+fuzz: native  ## deep cross-engine differential soak (set TRIALS=N, default 300)
+	S2VTPU_FUZZ_TRIALS=$(or $(TRIALS),300) $(PYTHON) -m pytest tests/test_fuzz_differential.py -q
 
 test: native
 	$(PYTHON) -m pytest tests/ -q

@@ -65,10 +65,11 @@ def test_four_engines_agree_with_artifacts():
         h = random_history(rng)
         events = h.events
         if trial % 2 == 1:
-            # The simulated service is sequential, so untampered histories
-            # are all linearizable; flip an observation to exercise the
-            # ILLEGAL side (may still be OK if an ambiguous branch covers
-            # the lie — the engines must simply keep agreeing).
+            # random_history already injects lies at a low rate, but most
+            # draws stay linearizable; tampering every other trial keeps
+            # the ILLEGAL side well represented (a tampered history may
+            # still be OK if an ambiguous branch covers the lie — the
+            # engines must simply keep agreeing).
             events = _tamper(events, rng) or events
         hist = prepare(events)
         want = check(hist)
@@ -84,7 +85,11 @@ def test_four_engines_agree_with_artifacts():
 
         if want.outcome == CheckOutcome.OK:
             oks += 1
-            for name, res in (("oracle", want), ("device", device)):
+            for name, res in (
+                ("oracle", want),
+                ("frontier", frontier),
+                ("device", device),
+            ):
                 assert res.linearization is not None, f"trial {trial}: {name}"
                 assert_valid_linearization(hist, res.linearization)
         elif want.outcome == CheckOutcome.ILLEGAL:
