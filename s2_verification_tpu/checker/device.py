@@ -74,7 +74,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..models.encode import INF_TIME, EncodedHistory, encode_history, intern_state
+from ..models.encode import (
+    INF_TIME,
+    EncodedHistory,
+    encode_history,
+    intern_state,
+    round_pow2,
+)
 from ..models.stream import StreamState
 from ..utils.cache import enable_persistent_cache
 from .entries import History
@@ -173,7 +179,10 @@ STOP_RUNNING, STOP_ACCEPT, STOP_EMPTY, STOP_CAPACITY = 0, 1, 2, 3
 
 
 def build_tables(enc: EncodedHistory) -> SearchTables:
-    n = enc.num_ops
+    # Padded length, not enc.num_ops: the derived masks must match the
+    # (shape-bucketed) array sizes; padded entries are inert by
+    # construction (trivial outputs, no tokens, in no chain).
+    n = int(enc.op_type.shape[0])
     c, lc = enc.chain_ops.shape
 
     is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
@@ -825,10 +834,9 @@ def run_search(
 
 
 def _round_pow2(n: int, lo: int) -> int:
-    v = lo
-    while v < n:
-        v *= 2
-    return v
+    # Shared with the encoder's shape bucketing (one rule for all
+    # compiled-program dimensions).
+    return round_pow2(n, lo)
 
 
 def _floor_pow2(n: int, lo: int) -> int:

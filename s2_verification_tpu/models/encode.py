@@ -32,9 +32,19 @@ import numpy as np
 from ..checker.entries import History, Op
 from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
 
-__all__ = ["EncodedHistory", "encode_history", "INF_TIME"]
+__all__ = ["EncodedHistory", "encode_history", "round_pow2", "INF_TIME"]
 
 INF_TIME = np.int32(2**31 - 1)
+
+
+def round_pow2(n: int, lo: int = 1) -> int:
+    """Smallest power of two >= n (but >= lo) — the shared shape-bucketing
+    rule for both the encoder's array dimensions and the driver's frontier
+    capacities."""
+    v = lo
+    while v < n:
+        v *= 2
+    return v
 
 
 @dataclass
@@ -77,10 +87,13 @@ class EncodedHistory:
     token_of_id: list[str | None] = field(default_factory=lambda: [None])
     #: op indices (into History.ops) in forced-prefix order
     forced_prefix: list[int] = field(default_factory=list)
+    #: real (unpadded) op count; arrays are shape-bucketed past it with
+    #: inert entries so distinct histories share compiled search programs
+    n_ops: int = -1
 
     @property
     def num_ops(self) -> int:
-        return int(self.op_type.shape[0])
+        return int(self.n_ops) if self.n_ops >= 0 else int(self.op_type.shape[0])
 
     @property
     def num_chains(self) -> int:
@@ -136,6 +149,16 @@ def encode_history(history: History) -> EncodedHistory:
     ops = history.ops
     keep = [op for op in ops if op.index not in forced_set]
     n = len(keep)
+    # Shape buckets: every array dimension that reaches a compiled program
+    # rounds up to a power of two, so distinct histories of similar size
+    # share XLA executables.  Without this, a long-lived process checking
+    # many histories compiles one program set per exact (N, C, Lc, R, L)
+    # tuple and accumulates compile state without bound (observed: an
+    # 800-history differential soak exhausted 125 GB of host RAM inside
+    # LLVM).  Padded ops are inert — trivial outputs, no tokens, in no
+    # chain — and padded chains are empty, so search semantics are
+    # unchanged; ``num_ops`` stays the real count.
+    n2 = round_pow2(n, 4) if n else 0
 
     tokens: dict[str, int] = {}
     token_of_id: list[str | None] = [None]
@@ -150,24 +173,30 @@ def encode_history(history: History) -> EncodedHistory:
             token_of_id.append(tok)
         return tid
 
-    op_type = np.zeros(n, np.int32)
-    has_set_token = np.zeros(n, bool)
-    set_token = np.zeros(n, np.int32)
-    has_batch_token = np.zeros(n, bool)
-    batch_token = np.zeros(n, np.int32)
-    has_match = np.zeros(n, bool)
-    match_seq = np.zeros(n, np.uint32)
-    num_records = np.zeros(n, np.uint32)
-    rh_row = np.zeros(n, np.int32)
-    rh_len = np.zeros(n, np.int32)
-    out_failure = np.zeros(n, bool)
-    out_definite = np.zeros(n, bool)
-    out_tail = np.zeros(n, np.uint32)
-    out_has_hash = np.zeros(n, bool)
-    out_hash_hi = np.zeros(n, np.uint32)
-    out_hash_lo = np.zeros(n, np.uint32)
-    call = np.zeros(n, np.int32)
-    ret = np.zeros(n, np.int32)
+    op_type = np.zeros(n2, np.int32)
+    has_set_token = np.zeros(n2, bool)
+    set_token = np.zeros(n2, np.int32)
+    has_batch_token = np.zeros(n2, bool)
+    batch_token = np.zeros(n2, np.int32)
+    has_match = np.zeros(n2, bool)
+    match_seq = np.zeros(n2, np.uint32)
+    num_records = np.zeros(n2, np.uint32)
+    rh_row = np.zeros(n2, np.int32)
+    rh_len = np.zeros(n2, np.int32)
+    out_failure = np.zeros(n2, bool)
+    out_definite = np.zeros(n2, bool)
+    out_tail = np.zeros(n2, np.uint32)
+    out_has_hash = np.zeros(n2, bool)
+    out_hash_hi = np.zeros(n2, np.uint32)
+    out_hash_lo = np.zeros(n2, np.uint32)
+    call = np.zeros(n2, np.int32)
+    ret = np.zeros(n2, np.int32)
+    # Inert pad defaults (overwritten below for the n real ops): trivial
+    # check-tail definite failures with windows at infinity.
+    op_type[n:] = 2
+    out_failure[n:] = True
+    out_definite[n:] = True
+    ret[n:] = INF_TIME
 
     append_rows: list[tuple[int, ...]] = []
     for j, op in enumerate(keep):
@@ -194,8 +223,8 @@ def encode_history(history: History) -> EncodedHistory:
         call[j] = op.call
         ret[j] = INF_TIME if op.pending else op.ret
 
-    r = max(1, len(append_rows))
-    width = max(1, max((len(row) for row in append_rows), default=1))
+    r = round_pow2(max(1, len(append_rows)))
+    width = round_pow2(max(1, max((len(row) for row in append_rows), default=1)))
     rh_hi = np.zeros((r, width), np.uint32)
     rh_lo = np.zeros((r, width), np.uint32)
     for i, row in enumerate(append_rows):
@@ -207,16 +236,17 @@ def encode_history(history: History) -> EncodedHistory:
     new_index = {op.index: j for j, op in enumerate(keep)}
     c = len(history.chains)
     chain_lists: list[list[int]] = [[] for _ in range(c)]
-    chain_of = np.zeros(n, np.int32)
+    chain_of = np.zeros(len(op_type), np.int32)
     for chain_id, members in enumerate(history.chains):
         for op_index in members:
             j = new_index.get(op_index)
             if j is not None:
                 chain_of[j] = chain_id
                 chain_lists[chain_id].append(j)
-    lc = max(1, max((len(m) for m in chain_lists), default=1))
-    chain_ops = np.full((max(1, c), lc), -1, np.int32)
-    chain_len = np.zeros(max(1, c), np.int32)
+    c2 = round_pow2(max(1, c), 2)
+    lc = round_pow2(max(1, max((len(m) for m in chain_lists), default=1)))
+    chain_ops = np.full((c2, lc), -1, np.int32)
+    chain_len = np.zeros(c2, np.int32)
     for chain_id, members in enumerate(chain_lists):
         chain_ops[chain_id, : len(members)] = members
         chain_len[chain_id] = len(members)
@@ -245,10 +275,11 @@ def encode_history(history: History) -> EncodedHistory:
         rh_lo=rh_lo,
         chain_ops=chain_ops,
         chain_len=chain_len,
-        chain_start=np.zeros(max(1, c), np.int32),
+        chain_start=np.zeros(c2, np.int32),
         init_states=init_states,
         token_of_id=token_of_id,
         forced_prefix=forced,
+        n_ops=n,
     )
 
 

@@ -5,18 +5,54 @@ engine used to pay the full XLA compile (minutes on hard histories) on
 every run.  Enabling JAX's persistent compilation cache makes repeat
 invocations of the same search shapes skip compilation entirely.
 
-Controlled by ``S2VTPU_COMPILE_CACHE``: unset → ``~/.cache/s2vtpu/xla``;
+Controlled by ``S2VTPU_COMPILE_CACHE``: unset → ``~/.cache/s2vtpu/xla-<host>``;
 set to a path → that path; set to empty → disabled.
+
+The default directory is namespaced by a host-CPU fingerprint: XLA:CPU
+AOT executables embed the compile machine's feature set, so entries
+written on one host generation mis-load on another (observed as
+cpu_aot_loader machine-feature warnings on every cache hit after a box
+change).  A per-host namespace starts a clean cache instead of paying
+mismatched loads forever.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 __all__ = ["enable_persistent_cache"]
 
-_DEFAULT = os.path.join("~", ".cache", "s2vtpu", "xla")
 _enabled: str | None = None
+
+
+def _host_fingerprint() -> str:
+    """Short stable id of this host's CPU feature set.
+
+    x86 /proc/cpuinfo exposes ``flags``, aarch64 exposes ``Features``;
+    either line captures the AOT-relevant feature set.  The fallback
+    hashes the full uname + machine string rather than
+    ``platform.processor()`` (empty on most Linux), so two different
+    host types never silently share a namespace just because the
+    fingerprint degenerated to a constant.
+    """
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:10]
+    except OSError:
+        pass
+    import platform
+
+    ident = "|".join([platform.machine(), platform.platform(), platform.processor()])
+    return hashlib.sha1(ident.encode()).hexdigest()[:10]
+
+
+def _default_dir() -> str:
+    return os.path.expanduser(
+        os.path.join("~", ".cache", "s2vtpu", f"xla-{_host_fingerprint()}")
+    )
 
 
 def enable_persistent_cache() -> str | None:
@@ -31,7 +67,7 @@ def enable_persistent_cache() -> str | None:
         return _enabled or None
     path = os.environ.get("S2VTPU_COMPILE_CACHE")
     if path is None:
-        path = os.path.expanduser(_DEFAULT)
+        path = _default_dir()
     if not path:
         _enabled = ""
         return None

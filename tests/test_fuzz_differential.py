@@ -103,3 +103,143 @@ def test_four_engines_agree_with_artifacts():
             assert refused, f"trial {trial}: no culprit named"
     # The generator must exercise both verdicts, else the sweep is vacuous.
     assert oks >= 3 and illegals >= 3, (oks, illegals)
+
+
+def random_history_medium(rng: random.Random):
+    """Medium random concurrent history WITH fencing semantics.
+
+    Like test_oracle_bruteforce.random_history but 3-4 clients, 8-16
+    events, and appends that set or carry fencing tokens (guarded like the
+    reference's fence command, history.rs:188-214) — the one op family the
+    small generator never exercises.  Outputs are produced by replaying a
+    real sequential stream at finish time (truthful histories are
+    linearizable by construction: the finish-order execution is a
+    witness), with occasional lies.
+    """
+    from helpers import H, fold
+    from s2_verification_tpu.utils.events import (
+        AppendDefiniteFailure,
+        AppendIndefiniteFailure,
+        AppendSuccess,
+        CheckTailSuccess,
+        ReadSuccess,
+    )
+
+    h = H()
+    n_clients = rng.randint(3, 4)
+    stream: list[int] = []
+    stream_token: str | None = None
+    open_ops: list[tuple] = []
+    next_hash = 1000
+    tokens = ["tokA", "tokB"]
+    for _ in range(rng.randint(8, 16)):
+        if open_ops and (rng.random() < 0.55 or len(open_ops) == n_clients):
+            i = rng.randrange(len(open_ops))
+            client, op, kind, hashes, match, token, set_token = open_ops.pop(i)
+            lie = rng.random() < 0.12
+            if kind == "append":
+                pre = (match is None or match == len(stream)) and (
+                    token is None or token == stream_token
+                )
+                r = rng.random()
+                if r < 0.2:
+                    if pre and rng.random() < 0.5:
+                        stream.extend(hashes)
+                        if set_token is not None:
+                            stream_token = set_token
+                    h.finish(client, op, AppendIndefiniteFailure())
+                elif pre and not lie:
+                    stream.extend(hashes)
+                    if set_token is not None:
+                        stream_token = set_token
+                    h.finish(client, op, AppendSuccess(tail=len(stream)))
+                elif not pre and lie:
+                    h.finish(
+                        client,
+                        op,
+                        AppendSuccess(tail=len(stream) + len(hashes)),
+                    )
+                else:
+                    h.finish(client, op, AppendDefiniteFailure())
+            elif kind == "read":
+                sh = fold(stream)
+                if lie:
+                    sh ^= 0xBAD
+                h.finish(
+                    client, op, ReadSuccess(tail=len(stream), stream_hash=sh)
+                )
+            else:
+                h.finish(
+                    client,
+                    op,
+                    CheckTailSuccess(tail=len(stream) + (1 if lie else 0)),
+                )
+        else:
+            busy = {c for c, *_ in open_ops}
+            free = [c for c in range(1, n_clients + 1) if c not in busy]
+            if not free:
+                continue
+            client = rng.choice(free)
+            kind = rng.choice(
+                ["append", "append", "append", "read", "check_tail"]
+            )
+            if kind == "append":
+                hashes = [next_hash + k for k in range(rng.randint(1, 3))]
+                next_hash += 10
+                match = len(stream) if rng.random() < 0.3 else None
+                token = set_token = None
+                r = rng.random()
+                if r < 0.15:
+                    # Fence: set a token, guarded by match_seq_num like the
+                    # reference's fence command record.
+                    set_token = rng.choice(tokens)
+                    match = len(stream)
+                elif r < 0.45 and stream_token is not None:
+                    token = (
+                        stream_token
+                        if rng.random() < 0.7
+                        else rng.choice(tokens)
+                    )
+                op = h.call_append(
+                    client, hashes, set_token=set_token, token=token, match=match
+                )
+                open_ops.append(
+                    (client, op, kind, hashes, match, token, set_token)
+                )
+            elif kind == "read":
+                op = h.call_read(client)
+                open_ops.append((client, op, kind, [], None, None, None))
+            else:
+                op = h.call_check_tail(client)
+                open_ops.append((client, op, kind, [], None, None, None))
+    return h
+
+
+def test_medium_fencing_histories_agree():
+    rng = random.Random(0xFE2C12)
+    oks = illegals = 0
+    for trial in range(TRIALS):
+        h = random_history_medium(rng)
+        hist = prepare(h.events)
+        want = check(hist)
+        frontier = check_frontier(hist)
+        device = check_device(
+            hist, max_frontier=512, start_frontier=32, beam=False
+        )
+        assert frontier.outcome == want.outcome, f"trial {trial}: frontier"
+        assert device.outcome == want.outcome, f"trial {trial}: device"
+        native = _native_or_none(hist)
+        if native is not None:
+            assert native.outcome == want.outcome, f"trial {trial}: native"
+        if want.outcome == CheckOutcome.OK:
+            oks += 1
+            for name, res in (
+                ("oracle", want),
+                ("frontier", frontier),
+                ("device", device),
+            ):
+                assert res.linearization is not None, f"trial {trial}: {name}"
+                assert_valid_linearization(hist, res.linearization)
+        elif want.outcome == CheckOutcome.ILLEGAL:
+            illegals += 1
+    assert oks >= 3 and illegals >= 3, (oks, illegals)
