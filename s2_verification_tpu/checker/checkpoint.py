@@ -40,9 +40,18 @@ class CheckpointError(ValueError):
     """A snapshot is unreadable or does not belong to this search."""
 
 
+#: Bumped whenever the encoder's array layout changes (e.g. the r3
+#: shape-bucketing): a checkpoint from another format must fail with an
+#: accurate message, not "different history".
+ENCODING_FORMAT = "v2-bucketed"
+
+
 def history_fingerprint(enc: EncodedHistory) -> str:
-    """Stable digest of everything the search semantics depend on."""
+    """Stable digest of everything the search semantics depend on,
+    prefixed with the encoding-format tag so stale-format snapshots are
+    distinguishable from different-history ones."""
     h = hashlib.sha256()
+    h.update(ENCODING_FORMAT.encode())
     for name in (
         "op_type",
         "has_set_token",
@@ -76,7 +85,22 @@ def history_fingerprint(enc: EncodedHistory) -> str:
         h.update(arr.tobytes())
     for s in sorted(enc.init_states):
         h.update(repr(s).encode())
-    return h.hexdigest()
+    return f"{ENCODING_FORMAT}:{h.hexdigest()}"
+
+
+def fingerprint_mismatch_reason(saved: str, current: str) -> str:
+    """Human-accurate diagnosis of a fingerprint mismatch: a snapshot from
+    an older encoding format (pre-bucketing checkpoints carry a bare hex
+    digest) is stale, not 'a different history'."""
+    saved_fmt = saved.split(":", 1)[0] if ":" in saved else "<pre-v2>"
+    cur_fmt = current.split(":", 1)[0]
+    if saved_fmt != cur_fmt:
+        return (
+            f"was written by encoding format {saved_fmt} (current "
+            f"{cur_fmt}) and cannot seed the new program shapes; delete "
+            "it to restart the search"
+        )
+    return "belongs to a different history (fingerprint mismatch)"
 
 
 @dataclass

@@ -1069,9 +1069,13 @@ def check_device(
         if os.path.exists(spill_snapshot):
             data = np.load(spill_snapshot, allow_pickle=False)
             if str(data["fingerprint"]) != fingerprint:
+                from .checkpoint import fingerprint_mismatch_reason
+
                 raise CheckpointError(
-                    f"spill checkpoint {spill_snapshot} belongs to a "
-                    "different history (fingerprint mismatch)"
+                    f"spill checkpoint {spill_snapshot} "
+                    + fingerprint_mismatch_reason(
+                        str(data["fingerprint"]), fingerprint
+                    )
                 )
             if beam or not spill:
                 raise CheckpointError(
@@ -1104,9 +1108,11 @@ def check_device(
         if os.path.exists(checkpoint_path):
             ck = load_checkpoint(checkpoint_path)
             if ck.fingerprint != fingerprint:
+                from .checkpoint import fingerprint_mismatch_reason
+
                 raise CheckpointError(
-                    f"checkpoint {checkpoint_path} belongs to a different "
-                    "history (fingerprint mismatch)"
+                    f"checkpoint {checkpoint_path} "
+                    + fingerprint_mismatch_reason(ck.fingerprint, fingerprint)
                 )
             if ck.beam != beam:
                 # A pruned beam frontier must never seed an exhaustive pass

@@ -47,6 +47,25 @@ def round_pow2(n: int, lo: int = 1) -> int:
     return v
 
 
+def _bucket_chains(c: int) -> int:
+    """Chain-count bucket: next multiple of 4 (min 2).
+
+    The chain axis multiplies every per-layer expansion and fold, so pow2
+    padding (e.g. 11 -> 16) costs real throughput; multiples of 4 cap the
+    waste at 3 empty chains while keeping the variant count bounded."""
+    return max(2, ((c + 3) // 4) * 4)
+
+
+def _bucket_len(length: int) -> int:
+    """Record-batch width bucket: pow2 up to 16, then multiples of 16.
+
+    The fold scan runs the PADDED width for every lane (masked), so this
+    axis directly multiplies fold cost; 100 -> 112 instead of 128."""
+    if length <= 16:
+        return round_pow2(length, 1)
+    return ((length + 15) // 16) * 16
+
+
 @dataclass
 class EncodedHistory:
     """Dense arrays over the N search-relevant ops (after forced-prefix
@@ -224,7 +243,7 @@ def encode_history(history: History) -> EncodedHistory:
         ret[j] = INF_TIME if op.pending else op.ret
 
     r = round_pow2(max(1, len(append_rows)))
-    width = round_pow2(max(1, max((len(row) for row in append_rows), default=1)))
+    width = _bucket_len(max(1, max((len(row) for row in append_rows), default=1)))
     rh_hi = np.zeros((r, width), np.uint32)
     rh_lo = np.zeros((r, width), np.uint32)
     for i, row in enumerate(append_rows):
@@ -243,7 +262,7 @@ def encode_history(history: History) -> EncodedHistory:
             if j is not None:
                 chain_of[j] = chain_id
                 chain_lists[chain_id].append(j)
-    c2 = round_pow2(max(1, c), 2)
+    c2 = _bucket_chains(c)
     lc = round_pow2(max(1, max((len(m) for m in chain_lists), default=1)))
     chain_ops = np.full((c2, lc), -1, np.int32)
     chain_len = np.zeros(c2, np.int32)

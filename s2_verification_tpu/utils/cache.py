@@ -74,6 +74,15 @@ def enable_persistent_cache() -> str | None:
     try:
         import jax
 
+        # One-time cleanup of the pre-namespacing default: its entries
+        # mis-load after any host change (machine-feature mismatch) and
+        # are never read again once the fingerprinted dir exists.
+        legacy = os.path.expanduser(os.path.join("~", ".cache", "s2vtpu", "xla"))
+        if os.path.isdir(legacy) and os.path.abspath(legacy) != os.path.abspath(path):
+            import shutil
+
+            shutil.rmtree(legacy, ignore_errors=True)
+
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         # Cache everything that takes noticeable time; the default 1s

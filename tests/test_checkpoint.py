@@ -177,27 +177,39 @@ def test_cli_stale_checkpoint_exits_64(hist, tmp_path):
 
 
 def test_mismatched_history_rejected(hist, tmp_path):
+    from s2_verification_tpu.checker.checkpoint import ENCODING_FORMAT
+
     ck = str(tmp_path / "search.ckpt")
     enc = encode_history(hist)
     import numpy as np
 
-    save_checkpoint(
-        ck,
-        Checkpoint(
-            fingerprint="deadbeef",
-            counts=np.zeros((2, enc.num_chains), np.int32),
-            tail=np.zeros(2, np.uint32),
-            hi=np.zeros(2, np.uint32),
-            lo=np.zeros(2, np.uint32),
-            tok=np.zeros(2, np.int32),
-            valid=np.zeros(2, bool),
-            f=2,
-            beam=False,
-            layers_done=0,
-            stats={},
-        ),
-    )
+    def snap(fp):
+        save_checkpoint(
+            ck,
+            Checkpoint(
+                fingerprint=fp,
+                counts=np.zeros((2, enc.num_chains), np.int32),
+                tail=np.zeros(2, np.uint32),
+                hi=np.zeros(2, np.uint32),
+                lo=np.zeros(2, np.uint32),
+                tok=np.zeros(2, np.int32),
+                valid=np.zeros(2, bool),
+                f=2,
+                beam=False,
+                layers_done=0,
+                stats={},
+            ),
+        )
+
+    # Same format, different history: blamed on the history.
+    snap(f"{ENCODING_FORMAT}:deadbeef")
     with pytest.raises(ValueError, match="fingerprint"):
+        check_device(hist, beam=False, checkpoint_path=ck)
+
+    # Pre-bucketing snapshot (bare hex digest): blamed on the stale
+    # encoding format, not the history.
+    snap("deadbeef")
+    with pytest.raises(ValueError, match="encoding format"):
         check_device(hist, beam=False, checkpoint_path=ck)
 
 
