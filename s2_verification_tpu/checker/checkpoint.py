@@ -43,7 +43,7 @@ class CheckpointError(ValueError):
 #: Bumped whenever the encoder's array layout changes (e.g. the r3
 #: shape-bucketing): a checkpoint from another format must fail with an
 #: accurate message, not "different history".
-ENCODING_FORMAT = "v2-bucketed"
+ENCODING_FORMAT = "v3-bucketed"
 
 
 def history_fingerprint(enc: EncodedHistory) -> str:

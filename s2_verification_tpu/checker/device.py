@@ -1059,6 +1059,7 @@ def check_device(
         from .checkpoint import (
             Checkpoint,
             CheckpointError,
+            fingerprint_mismatch_reason,
             history_fingerprint,
             load_checkpoint,
             save_checkpoint,
@@ -1069,8 +1070,6 @@ def check_device(
         if os.path.exists(spill_snapshot):
             data = np.load(spill_snapshot, allow_pickle=False)
             if str(data["fingerprint"]) != fingerprint:
-                from .checkpoint import fingerprint_mismatch_reason
-
                 raise CheckpointError(
                     f"spill checkpoint {spill_snapshot} "
                     + fingerprint_mismatch_reason(
@@ -1108,8 +1107,6 @@ def check_device(
         if os.path.exists(checkpoint_path):
             ck = load_checkpoint(checkpoint_path)
             if ck.fingerprint != fingerprint:
-                from .checkpoint import fingerprint_mismatch_reason
-
                 raise CheckpointError(
                     f"checkpoint {checkpoint_path} "
                     + fingerprint_mismatch_reason(ck.fingerprint, fingerprint)

@@ -10,10 +10,13 @@ set to a path → that path; set to empty → disabled.
 
 The default directory is namespaced by a host-CPU fingerprint: XLA:CPU
 AOT executables embed the compile machine's feature set, so entries
-written on one host generation mis-load on another (observed as
-cpu_aot_loader machine-feature warnings on every cache hit after a box
-change).  A per-host namespace starts a clean cache instead of paying
-mismatched loads forever.
+written on one host generation can mis-load (or SIGILL) on another.  A
+per-host namespace starts a clean cache on a box change instead of
+loading foreign executables.  (Note: cpu_aot_loader prints
+machine-feature warnings even for same-host entries — XLA appends
+synthetic `prefer-no-scatter/gather` options to the compile-time
+feature list that host detection never reports — so the warnings alone
+do not indicate a host change.)
 """
 
 from __future__ import annotations
@@ -74,14 +77,18 @@ def enable_persistent_cache() -> str | None:
     try:
         import jax
 
-        # One-time cleanup of the pre-namespacing default: its entries
-        # mis-load after any host change (machine-feature mismatch) and
-        # are never read again once the fingerprinted dir exists.
-        legacy = os.path.expanduser(os.path.join("~", ".cache", "s2vtpu", "xla"))
-        if os.path.isdir(legacy) and os.path.abspath(legacy) != os.path.abspath(path):
-            import shutil
+        # One-time cleanup of the pre-namespacing default — but only when
+        # running with the fingerprinted default itself (env unset): a
+        # user-configured dir must never trigger deletion of anything,
+        # least of all a cache they pointed at or under the legacy path.
+        if "S2VTPU_COMPILE_CACHE" not in os.environ:
+            legacy = os.path.expanduser(
+                os.path.join("~", ".cache", "s2vtpu", "xla")
+            )
+            if os.path.isdir(legacy):
+                import shutil
 
-            shutil.rmtree(legacy, ignore_errors=True)
+                shutil.rmtree(legacy, ignore_errors=True)
 
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
