@@ -108,7 +108,13 @@ class StreamOutput:
 
 
 def step(state: StreamState, inp: StreamInput, out: StreamOutput) -> list[StreamState]:
-    """All states consistent with observing (inp, out) from ``state``."""
+    """All states consistent with observing (inp, out) from ``state``.
+
+    Truth table: golang/s2-porcupine/main.go:264-335, mirrored exactly —
+    including the reference's open TODO (main.go:271): a set-fencing-token
+    append is NOT constrained to a single-record batch here either, so the
+    two models accept identical histories.
+    """
     if inp.input_type == APPEND:
         optimistic = StreamState(
             tail=(state.tail + (inp.num_records or 0)) & _U32,
