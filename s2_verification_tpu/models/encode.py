@@ -21,6 +21,11 @@ starts from the resulting state set.  This folds the collector's rectifying
 append (history.rs:650-679) — potentially covering a huge pre-existing
 stream — into the initial state instead of a maximal-width row of the hash
 matrix.
+
+Every array dimension is **shape-bucketed** (``round_pow2`` /
+``_bucket_chains`` / ``_bucket_len``) so distinct histories of similar
+size share compiled search programs; padded ops/chains are inert and
+``num_ops`` stays the real count.
 """
 
 from __future__ import annotations
