@@ -86,6 +86,7 @@ from ..utils.cache import enable_persistent_cache
 from .entries import History
 from .frontier import FrontierStats
 from .oracle import CheckOutcome, CheckResult
+from ..ops import u64
 from ..ops.step_kernel import DeviceOps, DeviceState, step_kernel
 
 __all__ = [
@@ -136,6 +137,12 @@ class SearchTables(NamedTuple):
     #: contribution of "chain c has linearized k ops"
     zob1: jnp.ndarray  # [C, Lc+2] uint32
     zob2: jnp.ndarray  # [C, Lc+2] uint32
+    #: mixed-radix strides (u64 as hi/lo u32 words) for the exact packed
+    #: counts key: key = sum_c counts[c] * stride[c].  Exact (collision-free)
+    #: iff prod(chain_len + 1) <= 2^64 (:func:`can_exact_pack`); zeros when
+    #: that product overflows and the generic full-vector compare is used.
+    pack_hi: jnp.ndarray  # [C] uint32
+    pack_lo: jnp.ndarray  # [C] uint32
 
 
 class Frontier(NamedTuple):
@@ -178,6 +185,27 @@ class RunOut(NamedTuple):
 STOP_RUNNING, STOP_ACCEPT, STOP_EMPTY, STOP_CAPACITY = 0, 1, 2, 3
 
 
+def _pack_strides(chain_len: np.ndarray) -> tuple[bool, np.ndarray]:
+    """Mixed-radix strides for the packed counts key, as Python-int math.
+
+    Returns ``(exact, strides_u64)``: ``exact`` iff every reachable counts
+    vector maps to a distinct value below 2^64 (prod(chain_len+1) <= 2^64),
+    in which case two counts vectors are equal iff their packed keys are —
+    the dedup compare collapses to one u64 word per row."""
+    strides = []
+    acc = 1
+    for ln in chain_len:
+        strides.append(acc % (1 << 64))
+        acc *= int(ln) + 1
+    exact = acc <= (1 << 64)
+    return exact, np.array(strides, dtype=np.uint64)
+
+
+def can_exact_pack(enc: EncodedHistory) -> bool:
+    """Whether this history's counts vectors pack exactly into u64 keys."""
+    return _pack_strides(enc.chain_len)[0]
+
+
 def build_tables(enc: EncodedHistory) -> SearchTables:
     # Padded length, not enc.num_ops: the derived masks must match the
     # (shape-bucketed) array sizes; padded entries are inert by
@@ -214,6 +242,9 @@ def build_tables(enc: EncodedHistory) -> SearchTables:
             )
     rng = np.random.Generator(np.random.PCG64(0x52C0FFEE))
     zob = rng.integers(0, 1 << 32, size=(2, c, lc + 2), dtype=np.uint32)
+    exact, strides = _pack_strides(enc.chain_len)
+    if not exact:
+        strides = np.zeros(c, np.uint64)
     return SearchTables(
         ops=DeviceOps.from_encoded(enc),
         is_indef=jnp.asarray(is_indef),
@@ -223,6 +254,8 @@ def build_tables(enc: EncodedHistory) -> SearchTables:
         opens_tab=jnp.asarray(opens_tab),
         zob1=jnp.asarray(zob[0]),
         zob2=jnp.asarray(zob[1]),
+        pack_hi=jnp.asarray((strides >> np.uint64(32)).astype(np.uint32)),
+        pack_lo=jnp.asarray(strides.astype(np.uint32)),
     )
 
 
@@ -478,7 +511,31 @@ def _zob_fold(zob, counts):
     return lax.reduce(contrib, _U32(0), lax.bitwise_xor, dimensions=(1,))
 
 
-def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool):
+def _u64_sum_axis1(x: u64.U64) -> u64.U64:
+    """Carry-correct sum of a U64 ``[F, C]`` matrix along axis 1 as a
+    log2(C)-depth tree of u64 adds — graph size O(log C), not O(C), so
+    many-chain histories don't grow the compiled layer."""
+    c = x.lo.shape[1]
+    n = 1 << max(0, (c - 1).bit_length())
+    hi = jnp.pad(x.hi, ((0, 0), (0, n - c)))
+    lo = jnp.pad(x.lo, ((0, 0), (0, n - c)))
+    while n > 1:
+        n //= 2
+        s = u64.add(
+            u64.from_arrays(hi[:, :n], lo[:, :n]),
+            u64.from_arrays(hi[:, n:], lo[:, n:]),
+        )
+        hi, lo = s.hi, s.lo
+    return u64.from_arrays(hi[:, 0], lo[:, 0])
+
+
+def _expand_layer(
+    tables: SearchTables,
+    frontier: Frontier,
+    *,
+    allow_prune: bool,
+    exact_pack: bool = False,
+):
     """Expand + dedup + compact one layer.  Returns the 10-tuple
     (children, pruned, overflow, n_unique, expanded, wparent, wop,
     n_steps, deep_row, children_are_diag): wparent/wop are the per-child
@@ -526,17 +583,43 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     k2 = jnp.concatenate([fl(sa.token), frontier.tok[parent]])
     valid2 = jnp.concatenate([fl(va), fl(vb)])
 
-    # Zobrist counts hash, updated incrementally per child.
-    pz1 = _zob_fold(tables.zob1, frontier.counts)  # [F]
-    pz2 = _zob_fold(tables.zob2, frontier.counts)
-    cnt_pc = frontier.counts[parent2, chain2]  # [e2]
-    d1 = tables.zob1[chain2, cnt_pc] ^ tables.zob1[chain2, cnt_pc + 1]
-    d2 = tables.zob2[chain2, cnt_pc] ^ tables.zob2[chain2, cnt_pc + 1]
-    cz1 = pz1[parent2] ^ d1
-    cz2 = pz2[parent2] ^ d2
+    if exact_pack:
+        # Exact mixed-radix counts key (prod(chain_len+1) <= 2^64, see
+        # _pack_strides): parent keys from an [F, C] u64 product + tree
+        # sum, child keys incrementally (+stride of the linearized chain).
+        # The dedup compare below is then two u32 words per row instead of
+        # a gathered [e2, C] counts compare — which both cuts the layer's
+        # peak HBM (the [e2, C] temporaries dominated at wide buckets)
+        # and drops the Zobrist gathers from the hash.
+        terms = u64.mul(
+            u64.from_arrays(
+                jnp.zeros((f, c), _U32), frontier.counts.astype(_U32)
+            ),
+            u64.from_arrays(
+                jnp.broadcast_to(tables.pack_hi[None, :], (f, c)),
+                jnp.broadcast_to(tables.pack_lo[None, :], (f, c)),
+            ),
+        )
+        pk = _u64_sum_axis1(terms)
+        pk2 = u64.add(
+            u64.from_arrays(pk.hi[parent2], pk.lo[parent2]),
+            u64.from_arrays(tables.pack_hi[chain2], tables.pack_lo[chain2]),
+        )
+        pkh2, pkl2 = pk2.hi, pk2.lo
+        hh1 = _mix_hash([pkh2, pkl2, t2, h2, l2, k2], e2, 0x811C9DC5)
+        hh2 = _mix_hash([pkl2, pkh2, t2, h2, l2, k2], e2, 0x9747B28C)
+    else:
+        # Zobrist counts hash, updated incrementally per child.
+        pz1 = _zob_fold(tables.zob1, frontier.counts)  # [F]
+        pz2 = _zob_fold(tables.zob2, frontier.counts)
+        cnt_pc = frontier.counts[parent2, chain2]  # [e2]
+        d1 = tables.zob1[chain2, cnt_pc] ^ tables.zob1[chain2, cnt_pc + 1]
+        d2 = tables.zob2[chain2, cnt_pc] ^ tables.zob2[chain2, cnt_pc + 1]
+        cz1 = pz1[parent2] ^ d1
+        cz2 = pz2[parent2] ^ d2
 
-    hh1 = _mix_hash([cz1, t2, h2, l2, k2], e2, 0x811C9DC5)
-    hh2 = _mix_hash([cz2, t2, h2, l2, k2], e2, 0x9747B28C)
+        hh1 = _mix_hash([cz1, t2, h2, l2, k2], e2, 0x811C9DC5)
+        hh2 = _mix_hash([cz2, t2, h2, l2, k2], e2, 0x9747B28C)
 
     # Scatter-min hash-table dedup: equal children share both hashes so all
     # copies land in one slot; the smallest row index wins, copies that
@@ -549,8 +632,9 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     surv = valid2
     # Loop-invariant pieces of the exact compare, hoisted out of the
     # probe rounds (only the winner side depends on the round).
-    ar = lax.iota(_I32, c)[None, :]
-    cc_i = frontier.counts[parent2] + (chain2[:, None] == ar).astype(_I32)
+    if not exact_pack:
+        ar = lax.iota(_I32, c)[None, :]
+        cc_i = frontier.counts[parent2] + (chain2[:, None] == ar).astype(_I32)
     for r in range(3):
         slot = (hh1 + _U32(r) * (hh2 | _U32(1))) & _U32(tsz - 1)
         tbl = jnp.full(tsz, e2, _I32).at[slot].min(
@@ -559,20 +643,27 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
         win = tbl[slot]
         w = jnp.minimum(win, e2 - 1)
         is_win = surv & (win == idx)
-        # Exact child-counts equality as a fused gather-compare-reduce —
-        # no materialized [e2, C] child-counts matrix (the old layer's
-        # largest buffer).  Full equality — NOT a same-chain shortcut — is
-        # load-bearing: the adversarial family's dedup merges are exactly
-        # the cross-chain A-then-B vs B-then-A reorderings, and requiring
-        # equal last chains blew the k=10 frontier up 10x (sequences
-        # instead of sets).
-        cc_w = frontier.counts[parent2[w]] + (chain2[w][:, None] == ar).astype(_I32)
+        # Exact child-counts equality.  Full equality — NOT a same-chain
+        # shortcut — is load-bearing: the adversarial family's dedup merges
+        # are exactly the cross-chain A-then-B vs B-then-A reorderings, and
+        # requiring equal last chains blew the k=10 frontier up 10x
+        # (sequences instead of sets).  With an exact packed key it is two
+        # u32 words; otherwise a fused gather-compare-reduce — no
+        # materialized [e2, C] child-counts matrix (the old layer's
+        # largest buffer).
+        if exact_pack:
+            cnt_eq = u64.eq(pk2, u64.from_arrays(pkh2[w], pkl2[w]))
+        else:
+            cc_w = frontier.counts[parent2[w]] + (
+                chain2[w][:, None] == ar
+            ).astype(_I32)
+            cnt_eq = (cc_i == cc_w).all(axis=1)
         eq = (
             (t2 == t2[w])
             & (h2 == h2[w])
             & (l2 == l2[w])
             & (k2 == k2[w])
-            & (cc_i == cc_w).all(axis=1)
+            & cnt_eq
         )
         dup = surv & ~is_win & eq
         keep_u = keep_u | is_win
@@ -648,7 +739,7 @@ def _expand_layer(tables: SearchTables, frontier: Frontier, *, allow_prune: bool
     )
 
 
-@partial(jax.jit, static_argnames=("allow_prune", "log_layers"))
+@partial(jax.jit, static_argnames=("allow_prune", "log_layers", "exact_pack"))
 def run_search(
     tables: SearchTables,
     frontier: Frontier,
@@ -656,6 +747,7 @@ def run_search(
     *,
     allow_prune: bool,
     log_layers: int = 0,
+    exact_pack: bool = False,
 ) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
@@ -670,6 +762,10 @@ def run_search(
     row, op*2+branch) — the witness log the driver walks backwards from the
     accept row to recover a concrete linearization.  The caller must keep
     ``max_layers <= log_layers``.
+
+    ``exact_pack=True`` (valid only when :func:`can_exact_pack` holds for
+    the encoded history) switches dedup to the exact u64 packed counts key
+    — same verdicts, far less HBM at wide buckets.
     """
 
     def body(carry: RunOut) -> RunOut:
@@ -694,7 +790,12 @@ def run_search(
             return lax.cond(
                 fastable,
                 fast,
-                partial(_expand_layer, tables, allow_prune=allow_prune),
+                partial(
+                    _expand_layer,
+                    tables,
+                    allow_prune=allow_prune,
+                    exact_pack=exact_pack,
+                ),
                 fr,
             )
 
@@ -981,6 +1082,7 @@ def check_device(
     witness_max_frontier: int = 0,
     spill: bool = False,
     spill_host_cap: int = 1 << 26,
+    exact_pack: bool | None = None,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1034,6 +1136,17 @@ def check_device(
     # falls back to counts-bounded recovery (_recover_witness_bounded).
     witness_requested = witness
     enc = encode_history(history)
+    # Exact packed-key dedup whenever the counts space fits u64 (every
+    # realistic workload but very-wide-and-long collector histories);
+    # ``exact_pack`` forces it on/off for differential testing.  Validate
+    # before any early return so the forced flag's contract is uniform.
+    if exact_pack and not can_exact_pack(enc):
+        # Zeroed strides would alias every counts vector to key 0 and
+        # silently merge distinct configurations — refuse instead.
+        raise ValueError(
+            "exact_pack=True requires prod(chain_len+1) <= 2^64 "
+            "(can_exact_pack); this history's counts space overflows u64"
+        )
     stats = FrontierStats()
     if enc.total_remaining == 0:
         res = CheckResult(
@@ -1045,6 +1158,7 @@ def check_device(
             res.stats = stats  # type: ignore[attr-defined]
         return res
     tables = build_tables(enc)
+    xp = can_exact_pack(enc) if exact_pack is None else bool(exact_pack)
     cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
@@ -1097,6 +1211,7 @@ def check_device(
                 fingerprint=fingerprint,
                 history=history,
                 witness_requested=witness_requested,
+                exact_pack=xp,
             )
             if res.outcome != CheckOutcome.UNKNOWN:
                 with contextlib.suppress(FileNotFoundError):
@@ -1191,6 +1306,7 @@ def check_device(
             np.int32(layers_budget),
             allow_prune=allow_prune,
             log_layers=_WITNESS_CHUNK if witness else 0,
+            exact_pack=xp,
         )
         # Scalar-only fetch: the frontier itself stays on device.  Pulling
         # the whole frontier back per segment (the previous design) moved
@@ -1319,6 +1435,7 @@ def check_device(
                     fingerprint=fingerprint if checkpoint_path else None,
                     history=history,
                     witness_requested=witness_requested,
+                    exact_pack=xp,
                 )
                 break
             stats.pruned = True
@@ -1785,6 +1902,7 @@ def _spill_search(
     fingerprint: str | None = None,
     history: History | None = None,
     witness_requested: bool = False,
+    exact_pack: bool = False,
 ) -> CheckResult:
     """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
 
@@ -1906,6 +2024,7 @@ def _spill_search(
                 to_device(host),
                 np.int32(cap_layers - stats.layers),
                 allow_prune=False,
+                exact_pack=exact_pack,
             )
             code, seg_layers, seg_live, seg_ac, seg_ex, accept_idx, dc = (
                 device_get(
@@ -2025,6 +2144,7 @@ def _spill_search(
                                 to_device(host[s0 : s0 + t0]),
                                 np.int32(1),
                                 allow_prune=False,
+                                exact_pack=exact_pack,
                             ),
                         )
                     )

@@ -13,11 +13,20 @@ compile; persistent cache makes repeats cheap) and steady (second run).
 from __future__ import annotations
 
 import argparse
+import logging
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Honor S2VTPU_LOG like the CLI does (cli.py): without a handler the
+# engine's per-segment DEBUG narration is silently dropped.
+logging.basicConfig(
+    level=os.environ.get("S2VTPU_LOG", "INFO").upper(),
+    stream=sys.stderr,
+    format="%(asctime)s %(name)s %(levelname)s %(message)s",
+)
 
 from s2_verification_tpu.utils.platform import pin_platform
 

@@ -614,3 +614,82 @@ def test_witness_recovery_budget_exhaustion_omits_witness():
     assert got is None  # budget too small -> omitted, no exception
     got = D._recover_witness_bounded(enc, hist, target)
     assert got is not None  # default budget succeeds on the same input
+
+
+def test_pack_strides_exactness_boundary():
+    """Stride math and the 2^64 exactness cutoff (pure host arithmetic)."""
+    import numpy as np
+
+    from s2_verification_tpu.checker.device import _pack_strides
+
+    exact, strides = _pack_strides(np.array([3, 1, 2], np.int32))
+    assert exact
+    # Mixed-radix: stride[0]=1, stride[1]=4 (radix 3+1), stride[2]=4*2.
+    assert strides.tolist() == [1, 4, 8]
+    # 8 chains of radix 256 multiply to exactly 2^64: every key fits u64.
+    exact, _ = _pack_strides(np.full(8, 255, np.int32))
+    assert exact
+    # One more value overflows: keys would alias, so packing is refused.
+    exact, _ = _pack_strides(np.array([255] * 8 + [1], np.int32))
+    assert not exact
+
+
+def test_device_packed_vs_generic_dedup_differential():
+    """exact_pack=True and =False must agree on verdict, witness validity,
+    final states, and the search shape (layers/expansions)."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    for k, unsat in ((5, False), (5, True), (6, False)):
+        hist = prepare(adversarial_events(k, batch=4, seed=2, unsatisfiable=unsat))
+        runs = {}
+        for xp in (True, False):
+            r = check_device(
+                hist,
+                max_frontier=4096,
+                start_frontier=16,
+                beam=False,
+                collect_stats=True,
+                exact_pack=xp,
+            )
+            runs[xp] = r
+        a, b = runs[True], runs[False]
+        assert a.outcome == b.outcome
+        if a.outcome == CheckOutcome.OK:
+            assert sorted(a.final_states) == sorted(b.final_states)
+            _assert_valid_linearization(hist, a.linearization)
+            _assert_valid_linearization(hist, b.linearization)
+        assert a.stats.layers == b.stats.layers
+        assert a.stats.expanded == b.stats.expanded
+
+
+def test_spill_packed_dedup_conclusive():
+    """The packed key flows through the out-of-core spill path too."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    r = check_device(
+        hist,
+        max_frontier=64,
+        start_frontier=16,
+        beam=False,
+        spill=True,
+        exact_pack=True,
+        collect_stats=True,
+    )
+    assert r.outcome == CheckOutcome.OK
+    _assert_valid_linearization(hist, r.linearization)
+
+
+def test_exact_pack_refused_when_unpackable():
+    """Forcing exact_pack on a counts space wider than u64 must raise, not
+    silently alias keys (zeroed strides would merge distinct configs)."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    # 65 single-op append chains + the read chain: product 2^66 > 2^64.
+    hist = prepare(adversarial_events(65, batch=1, seed=0))
+    from s2_verification_tpu.checker.device import can_exact_pack
+    from s2_verification_tpu.models.encode import encode_history
+
+    assert not can_exact_pack(encode_history(hist))
+    with pytest.raises(ValueError, match="exact_pack"):
+        check_device(hist, max_frontier=64, start_frontier=16, exact_pack=True)
