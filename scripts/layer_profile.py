@@ -1,0 +1,187 @@
+"""Apportion one expansion layer's cost: chain-hash fold vs dedup vs rest.
+
+Usage: python scripts/layer_profile.py [--k 10] [--batch 100]
+       [--frontier 524288] [--reps 5] [--no-exact-pack]
+
+Grows the adversarial k-instance to its peak frontier at the requested
+bucket, then times, steady-state, on whatever backend JAX_PLATFORMS
+selects:
+
+  step-sweep   the step_kernel sweep alone over [F, C] (the xxh3 chain
+               fold over each candidate op's record batch dominates it)
+  layer-nofold the full _expand_layer with step_kernel stubbed to a
+               fold-free passthrough (hash + scatter-min dedup + compact
+               structure only)
+  layer-full   the real _expand_layer
+
+layer-full - layer-nofold ~ fold share; layer-nofold is the dedup +
+gather/scatter structural share.  This is the measured basis for picking
+the next kernel optimization (SURVEY.md section 3.5 hot ops), replacing
+the indirect 1-record-batch comparison BASELINE.md used before.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(
+    level=os.environ.get("S2VTPU_LOG", "INFO").upper(),
+    stream=sys.stderr,
+    format="%(asctime)s %(name)s %(levelname)s %(message)s",
+)
+
+from s2_verification_tpu.utils.platform import pin_platform
+
+pin_platform()
+
+import jax
+import jax.numpy as jnp
+
+import s2_verification_tpu.checker.device as D
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.adversarial import adversarial_events
+from s2_verification_tpu.models.encode import encode_history
+from s2_verification_tpu.ops.step_kernel import DeviceState
+
+
+def _grow_to_peak(enc, tables, f: int, exact_pack: bool):
+    """Run single layers at bucket ``f`` and return the widest live
+    pre-expansion frontier reached (the peak layer's input)."""
+    frontier = D.init_frontier(enc, f)
+    best, best_live = frontier, int(jax.device_get(frontier.valid.sum()))
+    for _ in range(int(enc.total_remaining) + 2):
+        out = D.run_search(
+            tables, frontier, 1, allow_prune=False, exact_pack=exact_pack
+        )
+        code, live = jax.device_get((out.stop_code, out.frontier.valid.sum()))
+        if int(code) != D.STOP_RUNNING:
+            break
+        frontier = out.frontier
+        if int(live) > best_live:
+            best, best_live = frontier, int(live)
+    return best, best_live
+
+
+def _time(fn, reps: int) -> float:
+    fn()  # compile + warm
+    t0 = time.monotonic()
+    for _ in range(reps):
+        fn()
+    return (time.monotonic() - t0) / reps
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=100)
+    ap.add_argument(
+        "--frontier",
+        type=int,
+        default=1 << 19,
+        help="bucket rows (rounded down to a power of two; same unit as "
+        "adv_bench.py --frontier)",
+    )
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument(
+        "--no-exact-pack", dest="exact_pack", action="store_false", default=True
+    )
+    args = ap.parse_args()
+
+    hist = prepare(adversarial_events(args.k, batch=args.batch, seed=0))
+    enc = encode_history(hist)
+    tables = D.build_tables(enc)
+    xp = args.exact_pack and D.can_exact_pack(enc)
+    f = D._floor_pow2(args.frontier, 2)
+
+    frontier, live = _grow_to_peak(enc, tables, f, xp)
+    fc, c = frontier.counts.shape
+    print(
+        f"# backend={jax.default_backend()} k={args.k} batch={args.batch} "
+        f"bucket={fc} live={live} chains={c} e2={2 * fc * c} exact_pack={xp}",
+        flush=True,
+    )
+
+    # --- step-sweep: the [F, C] step_kernel map (fold included) ---------
+    @jax.jit
+    def step_sweep(fr):
+        nxt, cand = jax.vmap(partial(D._next_and_cands, tables))(fr.counts)
+
+        def row_step(t, h, l, k, nxt_row):
+            def per_chain(o):
+                sa, va, _sb, vb = D.step_kernel(
+                    tables.ops, o, DeviceState(t, h, l, k)
+                )
+                return sa, va, vb
+
+            return jax.vmap(per_chain)(nxt_row)
+
+        sa, va, vb = jax.vmap(row_step)(fr.tail, fr.hi, fr.lo, fr.tok, nxt)
+        # Consume the folded hash words too — reducing only tail lets XLA
+        # dead-code-eliminate the whole xxh3 scan and report fiction.
+        return (
+            sa.tail.sum() + sa.hash_hi.sum() + sa.hash_lo.sum(),
+            (va & cand).sum(),
+            (vb & cand).sum(),
+        )
+
+    t_sweep = _time(
+        lambda: jax.block_until_ready(step_sweep(frontier)), args.reps
+    )
+
+    # --- layer-nofold: _expand_layer with the fold stubbed out ----------
+    real_step = D.step_kernel
+
+    def stub_step(ops, op_idx, state):
+        # Same shapes/dtypes, no record-hash scan: successor A is a cheap
+        # arithmetic twist of the parent state, both branches "valid" (the
+        # dedup then sees realistic duplicate rates is not the goal —
+        # structural cost at identical array sizes is).
+        twist = DeviceState(
+            state.tail + ops.num_records[op_idx].astype(jnp.uint32),
+            state.hash_hi ^ op_idx.astype(jnp.uint32),
+            state.hash_lo + jnp.uint32(0x9E3779B9),
+            state.token,
+        )
+        one = jnp.bool_(True)
+        return twist, one, state, one
+
+    D.step_kernel = stub_step
+    try:
+        layer_nofold = jax.jit(
+            partial(D._expand_layer, tables, allow_prune=False, exact_pack=xp)
+        )
+        t_nofold = _time(
+            lambda: jax.block_until_ready(layer_nofold(frontier)), args.reps
+        )
+    finally:
+        D.step_kernel = real_step
+
+    # --- layer-full: the real thing -------------------------------------
+    layer_full = jax.jit(
+        partial(D._expand_layer, tables, allow_prune=False, exact_pack=xp)
+    )
+    t_full = _time(
+        lambda: jax.block_until_ready(layer_full(frontier)), args.reps
+    )
+
+    fold = max(t_full - t_nofold, 0.0)
+    print(f"step-sweep   {t_sweep * 1e3:9.1f} ms")
+    print(f"layer-nofold {t_nofold * 1e3:9.1f} ms   (hash+dedup+compact)")
+    print(f"layer-full   {t_full * 1e3:9.1f} ms")
+    print(
+        f"apportion: fold~{fold * 1e3:.1f} ms ({100 * fold / t_full:.0f}%), "
+        f"structure~{t_nofold * 1e3:.1f} ms ({100 * t_nofold / t_full:.0f}%)",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
