@@ -43,11 +43,15 @@ measurement with a FALLBACK note instead of a dead zero line.
 Env knobs (all optional): S2VTPU_BENCH_CLIENTS, S2VTPU_BENCH_OPS,
 S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S, S2VTPU_BENCH_ADV_K,
 S2VTPU_BENCH_ADV_BATCH, S2VTPU_BENCH_ADV_NATIVE_BUDGET_S,
-S2VTPU_BENCH_SKIP_ADV, S2VTPU_BENCH_NO_FALLBACK.
+S2VTPU_BENCH_SKIP_ADV, S2VTPU_BENCH_NO_FALLBACK,
+S2VTPU_BENCH_TPU_TIMEOUT_S (bound on the isolated measurement child,
+default 2700), S2VTPU_BENCH_NO_ISOLATE=1 (run the measurement in-process
+instead of the crash/hang-bounded child).
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -99,6 +103,104 @@ def _cpu_child_code(expr: str) -> str:
         "import bench\n"
         f"raise SystemExit({expr})\n"
     )
+
+
+def _tpu_child_code(expr: str) -> str:
+    """Re-exec stub for the device-measurement child: default platform,
+    but honoring an explicit JAX_PLATFORMS pin through the config API
+    (the axon sitecustomize hook overrides the env var)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return (
+        "import sys, os\n"
+        f"sys.path.insert(0, {here!r})\n"
+        "import jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "import bench\n"
+        f"raise SystemExit({expr})\n"
+    )
+
+
+def _isolated_device_run() -> int:
+    """Run the device measurement in a bounded child process.
+
+    The init probe only proves the tunnel was up at probe time; the axon
+    worker has also been observed to *crash or hang mid-measurement*
+    (e.g. on HBM exhaustion it dies rather than raising
+    RESOURCE_EXHAUSTED, taking the tunnel down with it).  A child bounds
+    both failure shapes: crash -> nonzero rc, hang -> timeout; either way
+    the parent degrades to the CPU fallback instead of wedging the driver
+    or dying without the contract line.  Same no-pipes discipline as the
+    init probe (a wedged grandchild would hold a pipe open forever)."""
+    import signal
+    import subprocess
+    import tempfile
+
+    timeout_s = float(os.environ.get("S2VTPU_BENCH_TPU_TIMEOUT_S", "2700"))
+    env = dict(os.environ)
+    env["S2VTPU_BENCH_TPU_CHILD"] = "1"
+    with tempfile.TemporaryFile() as out:
+        child = subprocess.Popen(
+            [sys.executable, "-c", _tpu_child_code("bench.north_star()")],
+            env=env,
+            stdout=out,
+            start_new_session=True,
+        )
+        try:
+            rc = child.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            with contextlib.suppress(ProcessLookupError):
+                os.killpg(child.pid, signal.SIGKILL)
+            out.seek(0)
+            outtxt = out.read().decode(errors="replace")
+            if '"metric"' in outtxt:
+                # The headline was measured before the hang (e.g. the
+                # auxiliary adversarial line wedged): keep it.
+                print(
+                    f"# device child hung >{timeout_s:.0f}s after the "
+                    "headline line; keeping it",
+                    file=sys.stderr,
+                )
+                sys.stdout.write(outtxt)
+                sys.stdout.flush()
+                return 0
+            return _cpu_fallback(
+                f"device measurement hung >{timeout_s:.0f}s; "
+                "TPU died mid-run?"
+            )
+        out.seek(0)
+        outtxt = out.read().decode(errors="replace")
+    if '"metric"' not in outtxt:
+        return _cpu_fallback(
+            f"device measurement child died (rc={rc}) before the "
+            "headline line; TPU crashed mid-run?"
+        )
+    sys.stdout.write(outtxt)
+    sys.stdout.flush()
+    if rc != 0:
+        if _metric_is_zero_line(outtxt):
+            # The child's own failure path already printed the dead-zero
+            # contract line (north_star swallows post-headline errors, so
+            # this is the only orderly nonzero exit): propagate failure.
+            return 1
+        # A real measurement followed by a messy death (e.g. the worker
+        # taking the process down after the headline): keep the number.
+        print(
+            f"# device child exited rc={rc} after the headline line; "
+            "keeping it",
+            file=sys.stderr,
+        )
+    return 0
+
+
+def _metric_is_zero_line(outtxt: str) -> bool:
+    """Whether the forwarded metric line is the dead-zero failure line."""
+    for line in outtxt.splitlines():
+        if '"metric"' in line:
+            with contextlib.suppress(ValueError):
+                d = json.loads(line)
+                return d.get("backend") == "none" or not d.get("value")
+    return True
 
 
 def _cpu_fallback(note: str) -> int:
@@ -158,8 +260,12 @@ def _cpu_fallback(note: str) -> int:
     # The headline line exists, so the run measured something; a child that
     # then died in the auxiliary adversarial stage (e.g. OOM at k=10) must
     # not turn a captured measurement into a failure — same rule as the
-    # timeout branch above and north_star's own try/except.
+    # timeout branch above and north_star's own try/except.  But a child
+    # whose "headline" is the dead-zero failure line did NOT measure:
+    # propagate its failure instead of laundering it to rc 0.
     if proc.returncode != 0:
+        if _metric_is_zero_line(outtxt):
+            return 1
         print(
             f"# CPU fallback child exited rc={proc.returncode} after the "
             "headline line; keeping it",
@@ -203,8 +309,12 @@ def north_star() -> int:
     # parseable zero line with a diagnostic if it wedges.
     import subprocess
 
+    is_child = (
+        os.environ.get("S2VTPU_BENCH_TPU_CHILD") == "1"
+        or os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1"
+    )
     probe_s = float(os.environ.get("S2VTPU_BENCH_INIT_TIMEOUT_S", "300"))
-    if probe_s > 0:
+    if probe_s > 0 and not is_child:
         import tempfile
 
         # No pipes: a killed-but-wedged child (or a libtpu grandchild
@@ -245,6 +355,12 @@ def north_star() -> int:
                     "backend init probe failed: "
                     + (err[-1] if err else f"rc={rc}, no output")
                 )
+
+    if not is_child and os.environ.get("S2VTPU_BENCH_NO_ISOLATE") != "1":
+        # Tunnel is up per the probe; still run the measurement itself in
+        # a bounded child — mid-run worker crashes and hangs are real
+        # (see _isolated_device_run).
+        return _isolated_device_run()
 
     clients = int(os.environ.get("S2VTPU_BENCH_CLIENTS", "5"))
     ops = int(os.environ.get("S2VTPU_BENCH_OPS", "2000"))

@@ -1,0 +1,83 @@
+"""The driver contract of bench.py: exactly one machine-readable JSON
+line on stdout, with a ``backend`` provenance marker, in every outcome —
+clean measurement, mid-run hang, and mid-run crash (the axon worker has
+died mid-measurement in practice; the driver must get a parseable line
+regardless).  Tiny instance sizes keep these subprocess-driven."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST = {
+    "S2VTPU_BENCH_CLIENTS": "2",
+    "S2VTPU_BENCH_OPS": "40",
+    "S2VTPU_BENCH_ORACLE_BUDGET_S": "5",
+    "S2VTPU_BENCH_SKIP_ADV": "1",
+    # The suite pins JAX_PLATFORMS=cpu (conftest); children re-pin via the
+    # config API, so everything below measures host cores.
+}
+
+
+def _run_bench(extra_env: dict[str, str], timeout: float = 300.0):
+    env = dict(os.environ) | FAST | extra_env
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        timeout=timeout,
+        cwd=REPO,
+    )
+    lines = [l for l in proc.stdout.decode().splitlines() if l.strip()]
+    metric_lines = [l for l in lines if '"metric"' in l]
+    assert len(metric_lines) == 1, (proc.stdout, proc.stderr[-2000:])
+    return proc, json.loads(metric_lines[0])
+
+
+def test_bench_clean_run_contract():
+    proc, line = _run_bench({})
+    assert proc.returncode == 0
+    assert line["metric"] == "ops_verified_per_sec_chip"
+    assert line["value"] > 0
+    assert line["backend"] == "cpu"
+    assert line["unit"] == "ops/s"
+
+
+def test_bench_midrun_hang_degrades_with_contract_line():
+    # A 1-second measurement budget guarantees the child is killed mid-run;
+    # NO_FALLBACK turns the degradation into the explicit zero line.
+    proc, line = _run_bench(
+        {"S2VTPU_BENCH_TPU_TIMEOUT_S": "1", "S2VTPU_BENCH_NO_FALLBACK": "1"}
+    )
+    assert proc.returncode == 1
+    assert line["value"] == 0.0
+    assert line["backend"] == "none"
+    assert b"hung" in proc.stderr
+
+
+def test_bench_midrun_crash_detected_with_contract_line():
+    # A poisoned env var crashes the measurement child after the probe;
+    # the parent must detect it and still print the contract line.
+    env = dict(os.environ) | FAST | {"S2VTPU_BENCH_OPS": "bogus"}
+    # The fallback child re-reads S2VTPU_BENCH_OPS, so poison only the
+    # isolated child via a var the fallback corrects: use NO_FALLBACK to
+    # assert the crash detection itself instead.
+    env["S2VTPU_BENCH_NO_FALLBACK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        timeout=300,
+        cwd=REPO,
+    )
+    lines = [l for l in proc.stdout.decode().splitlines() if '"metric"' in l]
+    assert len(lines) == 1
+    line = json.loads(lines[0])
+    assert line["value"] == 0.0 and line["backend"] == "none"
+    assert b"child died" in proc.stderr
