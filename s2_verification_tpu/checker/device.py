@@ -535,6 +535,7 @@ def _expand_layer(
     *,
     allow_prune: bool,
     exact_pack: bool = False,
+    sort_dedup: bool = False,
 ):
     """Expand + dedup + compact one layer.  Returns the 10-tuple
     (children, pruned, overflow, n_unique, expanded, wparent, wop,
@@ -621,55 +622,90 @@ def _expand_layer(
         hh1 = _mix_hash([cz1, t2, h2, l2, k2], e2, 0x811C9DC5)
         hh2 = _mix_hash([cz2, t2, h2, l2, k2], e2, 0x9747B28C)
 
-    # Scatter-min hash-table dedup: equal children share both hashes so all
-    # copies land in one slot; the smallest row index wins, copies that
-    # exact-compare equal to the winner drop, unequal collisions re-probe.
-    # Rows still colliding after the probe rounds are kept — a missed merge
-    # wastes a row but never changes a verdict.
-    tsz = 1 << max(1, (e2 - 1).bit_length())
-    idx = idx2
-    keep_u = jnp.zeros(e2, bool)
-    surv = valid2
-    # Loop-invariant pieces of the exact compare, hoisted out of the
-    # probe rounds (only the winner side depends on the round).
-    if not exact_pack:
-        ar = lax.iota(_I32, c)[None, :]
-        cc_i = frontier.counts[parent2] + (chain2[:, None] == ar).astype(_I32)
-    for r in range(3):
-        slot = (hh1 + _U32(r) * (hh2 | _U32(1))) & _U32(tsz - 1)
-        tbl = jnp.full(tsz, e2, _I32).at[slot].min(
-            jnp.where(surv, idx, e2), mode="drop"
+    if exact_pack and sort_dedup:
+        # Sort-based exact dedup: with the packed key the whole child
+        # identity is six u32 words, so one lexicographic sort (invalid
+        # rows keyed last) puts every duplicate adjacent to its twin —
+        # PERFECT dedup (no missed merges), deterministic by total order
+        # (idx2 is the final key, see below — do not drop it: it is what
+        # keeps the smallest original row first in each run without
+        # relying on sort stability), and scatter-free except one
+        # unique-index boolean write-back.
+        # The alternative below costs three colliding scatter-min passes
+        # over a 2x table, which TPU serializes per colliding update.
+        # idx2 is the final key (not a carried value): the total order
+        # makes the result deterministic without a stable sort, and the
+        # first row of every equal-identity run is the smallest original
+        # index — the same winner rule as the scatter path.
+        sb, s0, s1, s2, s3, s4, s5, sidx = lax.sort(
+            (~valid2, pkh2, pkl2, t2, h2, l2, k2, idx2), num_keys=8
         )
-        win = tbl[slot]
-        w = jnp.minimum(win, e2 - 1)
-        is_win = surv & (win == idx)
-        # Exact child-counts equality.  Full equality — NOT a same-chain
-        # shortcut — is load-bearing: the adversarial family's dedup merges
-        # are exactly the cross-chain A-then-B vs B-then-A reorderings, and
-        # requiring equal last chains blew the k=10 frontier up 10x
-        # (sequences instead of sets).  With an exact packed key it is two
-        # u32 words; otherwise a fused gather-compare-reduce — no
-        # materialized [e2, C] child-counts matrix (the old layer's
-        # largest buffer).
-        if exact_pack:
-            cnt_eq = u64.eq(pk2, u64.from_arrays(pkh2[w], pkl2[w]))
-        else:
-            cc_w = frontier.counts[parent2[w]] + (
-                chain2[w][:, None] == ar
-            ).astype(_I32)
-            cnt_eq = (cc_i == cc_w).all(axis=1)
-        eq = (
-            (t2 == t2[w])
-            & (h2 == h2[w])
-            & (l2 == l2[w])
-            & (k2 == k2[w])
-            & cnt_eq
+        shift = lambda x: jnp.concatenate([x[:1], x[:-1]])
+        same_prev = (
+            (s0 == shift(s0))
+            & (s1 == shift(s1))
+            & (s2 == shift(s2))
+            & (s3 == shift(s3))
+            & (s4 == shift(s4))
+            & (s5 == shift(s5))
+            & (lax.iota(_I32, e2) > 0)
         )
-        dup = surv & ~is_win & eq
-        keep_u = keep_u | is_win
-        surv = surv & ~is_win & ~dup
-    keep = keep_u | surv
-    n_unique = keep.sum()
+        head = ~sb & ~same_prev
+        keep = jnp.zeros(e2, bool).at[sidx].set(head, mode="drop")
+        n_unique = head.sum()
+    else:
+        # Scatter-min hash-table dedup: equal children share both hashes
+        # so all copies land in one slot; the smallest row index wins,
+        # copies that exact-compare equal to the winner drop, unequal
+        # collisions re-probe.  Rows still colliding after the probe
+        # rounds are kept — a missed merge wastes a row but never changes
+        # a verdict.
+        tsz = 1 << max(1, (e2 - 1).bit_length())
+        idx = idx2
+        keep_u = jnp.zeros(e2, bool)
+        surv = valid2
+        # Loop-invariant pieces of the exact compare, hoisted out of the
+        # probe rounds (only the winner side depends on the round).
+        if not exact_pack:
+            ar = lax.iota(_I32, c)[None, :]
+            cc_i = frontier.counts[parent2] + (chain2[:, None] == ar).astype(
+                _I32
+            )
+        for r in range(3):
+            slot = (hh1 + _U32(r) * (hh2 | _U32(1))) & _U32(tsz - 1)
+            tbl = jnp.full(tsz, e2, _I32).at[slot].min(
+                jnp.where(surv, idx, e2), mode="drop"
+            )
+            win = tbl[slot]
+            w = jnp.minimum(win, e2 - 1)
+            is_win = surv & (win == idx)
+            # Exact child-counts equality.  Full equality — NOT a
+            # same-chain shortcut — is load-bearing: the adversarial
+            # family's dedup merges are exactly the cross-chain A-then-B
+            # vs B-then-A reorderings, and requiring equal last chains
+            # blew the k=10 frontier up 10x (sequences instead of sets).
+            # With an exact packed key it is two u32 words; otherwise a
+            # fused gather-compare-reduce — no materialized [e2, C]
+            # child-counts matrix (the old layer's largest buffer).
+            if exact_pack:
+                cnt_eq = u64.eq(pk2, u64.from_arrays(pkh2[w], pkl2[w]))
+            else:
+                cc_w = frontier.counts[parent2[w]] + (
+                    chain2[w][:, None] == ar
+                ).astype(_I32)
+                cnt_eq = (cc_i == cc_w).all(axis=1)
+            eq = (
+                (t2 == t2[w])
+                & (h2 == h2[w])
+                & (l2 == l2[w])
+                & (k2 == k2[w])
+                & cnt_eq
+            )
+            dup = surv & ~is_win & eq
+            keep_u = keep_u | is_win
+            surv = surv & ~is_win & ~dup
+        keep = keep_u | surv
+        n_unique = keep.sum()
 
     # Lazy-order rank: total indefinite appends linearized (fewest first).
     p_opens = jnp.take_along_axis(
@@ -739,7 +775,10 @@ def _expand_layer(
     )
 
 
-@partial(jax.jit, static_argnames=("allow_prune", "log_layers", "exact_pack"))
+@partial(
+    jax.jit,
+    static_argnames=("allow_prune", "log_layers", "exact_pack", "sort_dedup"),
+)
 def run_search(
     tables: SearchTables,
     frontier: Frontier,
@@ -748,6 +787,7 @@ def run_search(
     allow_prune: bool,
     log_layers: int = 0,
     exact_pack: bool = False,
+    sort_dedup: bool = False,
 ) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
@@ -765,7 +805,11 @@ def run_search(
 
     ``exact_pack=True`` (valid only when :func:`can_exact_pack` holds for
     the encoded history) switches dedup to the exact u64 packed counts key
-    — same verdicts, far less HBM at wide buckets.
+    — same verdicts, far less HBM at wide buckets.  ``sort_dedup=True``
+    (requires ``exact_pack``) replaces the scatter-min probe table with a
+    lexicographic sort over the full child identity: perfect dedup, no
+    colliding scatters — the variant built for TPU, where scatter updates
+    on colliding indices serialize.
     """
 
     def body(carry: RunOut) -> RunOut:
@@ -795,6 +839,7 @@ def run_search(
                     tables,
                     allow_prune=allow_prune,
                     exact_pack=exact_pack,
+                    sort_dedup=sort_dedup,
                 ),
                 fr,
             )
@@ -1083,6 +1128,7 @@ def check_device(
     spill: bool = False,
     spill_host_cap: int = 1 << 26,
     exact_pack: bool | None = None,
+    sort_dedup: bool | None = None,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1159,6 +1205,26 @@ def check_device(
         return res
     tables = build_tables(enc)
     xp = can_exact_pack(enc) if exact_pack is None else bool(exact_pack)
+    # Sort-based dedup needs the packed identity.  An explicit
+    # sort_dedup=True on an unpackable history refuses (same contract as
+    # exact_pack=True — silently measuring the probe path instead would
+    # invalidate the experiment the flag exists for); the env-var opt-in
+    # (S2VTPU_SORT_DEDUP=1, usable across mixed workloads) degrades to
+    # the probe table with a debug note.  Default off pending an on-chip
+    # measurement.
+    if sort_dedup and not xp:
+        raise ValueError(
+            "sort_dedup=True requires the exact packed counts key "
+            "(can_exact_pack / exact_pack); this history cannot pack"
+        )
+    if sort_dedup is None:
+        sort_dedup = os.environ.get("S2VTPU_SORT_DEDUP") == "1"
+        if sort_dedup and not xp:
+            log.debug(
+                "S2VTPU_SORT_DEDUP=1 ignored: history's counts space "
+                "overflows the u64 packed key; using the probe table"
+            )
+    sd = bool(sort_dedup) and xp
     cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
@@ -1212,6 +1278,7 @@ def check_device(
                 history=history,
                 witness_requested=witness_requested,
                 exact_pack=xp,
+                sort_dedup=sd,
             )
             if res.outcome != CheckOutcome.UNKNOWN:
                 with contextlib.suppress(FileNotFoundError):
@@ -1307,6 +1374,7 @@ def check_device(
             allow_prune=allow_prune,
             log_layers=_WITNESS_CHUNK if witness else 0,
             exact_pack=xp,
+            sort_dedup=sd,
         )
         # Scalar-only fetch: the frontier itself stays on device.  Pulling
         # the whole frontier back per segment (the previous design) moved
@@ -1436,6 +1504,7 @@ def check_device(
                     history=history,
                     witness_requested=witness_requested,
                     exact_pack=xp,
+                    sort_dedup=sd,
                 )
                 break
             stats.pruned = True
@@ -1903,6 +1972,7 @@ def _spill_search(
     history: History | None = None,
     witness_requested: bool = False,
     exact_pack: bool = False,
+    sort_dedup: bool = False,
 ) -> CheckResult:
     """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
 
@@ -2025,6 +2095,7 @@ def _spill_search(
                 np.int32(cap_layers - stats.layers),
                 allow_prune=False,
                 exact_pack=exact_pack,
+                sort_dedup=sort_dedup,
             )
             code, seg_layers, seg_live, seg_ac, seg_ex, accept_idx, dc = (
                 device_get(
@@ -2145,6 +2216,7 @@ def _spill_search(
                                 np.int32(1),
                                 allow_prune=False,
                                 exact_pack=exact_pack,
+                                sort_dedup=sort_dedup,
                             ),
                         )
                     )

@@ -1,7 +1,7 @@
 """Apportion one expansion layer's cost: chain-hash fold vs dedup vs rest.
 
 Usage: python scripts/layer_profile.py [--k 10] [--batch 100]
-       [--frontier 524288] [--reps 5] [--no-exact-pack]
+       [--frontier 524288] [--reps 5] [--no-exact-pack] [--sort-dedup]
 
 Grows the adversarial k-instance to its peak frontier at the requested
 bucket, then times, steady-state, on whatever backend JAX_PLATFORMS
@@ -92,19 +92,26 @@ def main() -> int:
     ap.add_argument(
         "--no-exact-pack", dest="exact_pack", action="store_false", default=True
     )
+    ap.add_argument("--sort-dedup", action="store_true")
     args = ap.parse_args()
 
     hist = prepare(adversarial_events(args.k, batch=args.batch, seed=0))
     enc = encode_history(hist)
     tables = D.build_tables(enc)
     xp = args.exact_pack and D.can_exact_pack(enc)
+    # The sort path only exists under the packed key (device.py guard);
+    # report the path that actually runs, not the one requested.
+    sort_dedup = args.sort_dedup and xp
+    if args.sort_dedup and not sort_dedup:
+        print("# --sort-dedup ignored: exact packing unavailable", flush=True)
     f = D._floor_pow2(args.frontier, 2)
 
     frontier, live = _grow_to_peak(enc, tables, f, xp)
     fc, c = frontier.counts.shape
     print(
         f"# backend={jax.default_backend()} k={args.k} batch={args.batch} "
-        f"bucket={fc} live={live} chains={c} e2={2 * fc * c} exact_pack={xp}",
+        f"bucket={fc} live={live} chains={c} e2={2 * fc * c} exact_pack={xp} "
+        f"sort_dedup={sort_dedup}",
         flush=True,
     )
 
@@ -155,7 +162,13 @@ def main() -> int:
     D.step_kernel = stub_step
     try:
         layer_nofold = jax.jit(
-            partial(D._expand_layer, tables, allow_prune=False, exact_pack=xp)
+            partial(
+                D._expand_layer,
+                tables,
+                allow_prune=False,
+                exact_pack=xp,
+                sort_dedup=sort_dedup,
+            )
         )
         t_nofold = _time(
             lambda: jax.block_until_ready(layer_nofold(frontier)), args.reps
@@ -165,7 +178,13 @@ def main() -> int:
 
     # --- layer-full: the real thing -------------------------------------
     layer_full = jax.jit(
-        partial(D._expand_layer, tables, allow_prune=False, exact_pack=xp)
+        partial(
+            D._expand_layer,
+            tables,
+            allow_prune=False,
+            exact_pack=xp,
+            sort_dedup=sort_dedup,
+        )
     )
     t_full = _time(
         lambda: jax.block_until_ready(layer_full(frontier)), args.reps

@@ -693,3 +693,71 @@ def test_exact_pack_refused_when_unpackable():
     assert not can_exact_pack(encode_history(hist))
     with pytest.raises(ValueError, match="exact_pack"):
         check_device(hist, max_frontier=64, start_frontier=16, exact_pack=True)
+
+
+def test_device_sort_dedup_differential():
+    """Sort-based and scatter-based dedup must agree on verdict, witness,
+    final states, and layer count (expansions can differ only if the probe
+    table ever missed a merge; on these sizes it does not)."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    for k, unsat in ((5, False), (5, True), (6, False)):
+        hist = prepare(adversarial_events(k, batch=4, seed=3, unsatisfiable=unsat))
+        runs = {}
+        for sd in (True, False):
+            runs[sd] = check_device(
+                hist,
+                max_frontier=4096,
+                start_frontier=16,
+                beam=False,
+                collect_stats=True,
+                sort_dedup=sd,
+            )
+        a, b = runs[True], runs[False]
+        assert a.outcome == b.outcome
+        if a.outcome == CheckOutcome.OK:
+            assert sorted(a.final_states) == sorted(b.final_states)
+            _assert_valid_linearization(hist, a.linearization)
+        assert a.stats.layers == b.stats.layers
+        assert a.stats.expanded == b.stats.expanded
+
+
+def test_device_sort_dedup_on_collected_history_and_spill():
+    """The sort path decides a real collected history and flows through
+    the out-of-core spill."""
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=30,
+            workflow="fencing",
+            seed=11,
+        )
+    )
+    hist = prepare(events)
+    r = check_device(hist, max_frontier=4096, start_frontier=16, sort_dedup=True)
+    assert r.outcome == CheckOutcome.OK
+    _assert_valid_linearization(hist, r.linearization)
+
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    r = check_device(
+        hist,
+        max_frontier=64,
+        start_frontier=16,
+        beam=False,
+        spill=True,
+        sort_dedup=True,
+    )
+    assert r.outcome == CheckOutcome.OK
+    _assert_valid_linearization(hist, r.linearization)
+
+
+def test_sort_dedup_refused_when_unpackable():
+    """Explicit sort_dedup=True without the packed key must refuse (env
+    opt-in degrades instead; the explicit flag is an experiment contract)."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(65, batch=1, seed=0))
+    with pytest.raises(ValueError, match="sort_dedup"):
+        check_device(hist, max_frontier=64, start_frontier=16, sort_dedup=True)
