@@ -443,7 +443,15 @@ def adversarial_line() -> None:
     k0 = int(os.environ.get("S2VTPU_BENCH_ADV_K", "10"))
     batch = int(os.environ.get("S2VTPU_BENCH_ADV_BATCH", "100"))
     native_budget = float(os.environ.get("S2VTPU_BENCH_ADV_NATIVE_BUDGET_S", "60"))
-    kw = dict(max_frontier=1 << 21, start_frontier=1 << 14, beam=False, witness=False)
+    kw = dict(
+        max_frontier=1 << 21,
+        start_frontier=1 << 14,
+        beam=False,
+        witness=False,
+        # HBM-resident chunked tier: lets k>=11 peaks (and k=12's 10.85 M
+        # rows) stay on device instead of spilling over the tunnel.
+        device_rows_cap=int(os.environ.get("S2VTPU_BENCH_DEVICE_ROWS", str(1 << 24))),
+    )
 
     for k in (k0, k0 - 1):  # one fallback step if k0 exceeds this chip
         hist = prepare(adversarial_events(k, batch=batch, seed=0))

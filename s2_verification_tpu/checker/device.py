@@ -511,6 +511,29 @@ def _zob_fold(zob, counts):
     return lax.reduce(contrib, _U32(0), lax.bitwise_xor, dimensions=(1,))
 
 
+def _dedup_sort(invalid, ident, values=()):
+    """Total-order sort + adjacent-equality head mask over a 6-word packed
+    identity — THE dedup kernel shared by the one-shot sort path, the
+    chunked per-chunk pass, and the chunked cross-chunk pass (one
+    implementation so the identity tuple can never silently diverge).
+
+    ``invalid`` keys last; a per-row index is appended as the final key so
+    the order is total (deterministic without a stable sort) and the first
+    row of every equal-identity run has the smallest index.  Returns
+    ``(head, sorted_ident, sorted_idx, sorted_values)`` — ``head`` marks,
+    in sorted space, the first (winning) row of each valid identity run.
+    """
+    n = invalid.shape[0]
+    out = lax.sort((invalid, *ident, lax.iota(_I32, n), *values), num_keys=8)
+    sb, sid, sidx, svals = out[0], out[1:7], out[7], out[8:]
+    shift = lambda x: jnp.concatenate([x[:1], x[:-1]])
+    same_prev = (lax.iota(_I32, n) > 0)
+    for w in sid:
+        same_prev = same_prev & (w == shift(w))
+    head = ~sb & ~same_prev
+    return head, sid, sidx, svals
+
+
 def _u64_sum_axis1(x: u64.U64) -> u64.U64:
     """Carry-correct sum of a U64 ``[F, C]`` matrix along axis 1 as a
     log2(C)-depth tree of u64 adds — graph size O(log C), not O(C), so
@@ -633,24 +656,9 @@ def _expand_layer(
         # unique-index boolean write-back.
         # The alternative below costs three colliding scatter-min passes
         # over a 2x table, which TPU serializes per colliding update.
-        # idx2 is the final key (not a carried value): the total order
-        # makes the result deterministic without a stable sort, and the
-        # first row of every equal-identity run is the smallest original
-        # index — the same winner rule as the scatter path.
-        sb, s0, s1, s2, s3, s4, s5, sidx = lax.sort(
-            (~valid2, pkh2, pkl2, t2, h2, l2, k2, idx2), num_keys=8
+        head, _sid, sidx, _sv = _dedup_sort(
+            ~valid2, (pkh2, pkl2, t2, h2, l2, k2)
         )
-        shift = lambda x: jnp.concatenate([x[:1], x[:-1]])
-        same_prev = (
-            (s0 == shift(s0))
-            & (s1 == shift(s1))
-            & (s2 == shift(s2))
-            & (s3 == shift(s3))
-            & (s4 == shift(s4))
-            & (s5 == shift(s5))
-            & (lax.iota(_I32, e2) > 0)
-        )
-        head = ~sb & ~same_prev
         keep = jnp.zeros(e2, bool).at[sidx].set(head, mode="drop")
         n_unique = head.sum()
     else:
@@ -775,9 +783,224 @@ def _expand_layer(
     )
 
 
+def _expand_layer_chunked(
+    tables: SearchTables, frontier: Frontier, *, chunk_rows: int
+):
+    """One exhaustive expansion layer over a frontier too wide to expand in
+    one piece: the frontier stays device-resident at full width F while the
+    expansion working set (2*chunk*C lanes) is bounded by ``chunk_rows``.
+
+    This is the middle tier between in-core expansion and the host-RAM
+    spill: a frontier that fits HBM but whose one-shot expansion buffers
+    would not (e.g. the adversarial k=12 peak, 10.85 M rows — trivially
+    HBM-resident, yet e2 = 2FC lanes of working set at full width would
+    need tens of GB).  The host spill streams every peak layer over
+    host<->device transfers, which ride a slow tunnel in this environment;
+    chunking keeps everything on device.
+
+    Requires the exact packed counts key (identity = 6 u32 words, enforced
+    by the caller's gating): each chunk dedups internally with
+    :func:`_dedup_sort` and appends its unique children behind a write
+    cursor; when an append would overflow, the buffer is first compacted
+    by a cross-chunk dedup (duplicates of rows appended by earlier chunks
+    are merged) and only a still-overflowing append reports capacity —
+    children incomplete, pre-expansion frontier intact, same contract as
+    the one-shot layer.  A final cross-chunk pass dedups and compacts the
+    committed buffer.  Exhaustive only (no beam).  Returns the
+    :func:`_expand_layer` 10-tuple; on overflow the n_unique element
+    carries the total appended-rows estimate so the driver's
+    jump-to-fitting-bucket escalation keeps working (post-dedup counts
+    are capped at F and would degenerate it to fixed x4 steps).
+    """
+    f, c = frontier.counts.shape
+    assert f % chunk_rows == 0 and chunk_rows < f
+    ops = tables.ops
+    ce = chunk_rows * c
+    ce2 = 2 * ce
+
+    # Children buffer: identity words + witness metadata, written densely
+    # behind a cursor.  Validity of slot i is "i < cursor".
+    cb0 = (
+        jnp.zeros(f, _U32),  # pkh
+        jnp.zeros(f, _U32),  # pkl
+        jnp.zeros(f, _U32),  # tail
+        jnp.zeros(f, _U32),  # hash hi
+        jnp.zeros(f, _U32),  # hash lo
+        jnp.zeros(f, _I32),  # token
+        jnp.zeros(f, _I32),  # parent row (global)
+        jnp.zeros(f, _I32),  # op*2+branch
+    )
+
+    # Parent packed keys for the WHOLE frontier (one cheap [F, C] pass);
+    # chunks gather their slices.
+    terms = u64.mul(
+        u64.from_arrays(jnp.zeros((f, c), _U32), frontier.counts.astype(_U32)),
+        u64.from_arrays(
+            jnp.broadcast_to(tables.pack_hi[None, :], (f, c)),
+            jnp.broadcast_to(tables.pack_lo[None, :], (f, c)),
+        ),
+    )
+    pk_all = _u64_sum_axis1(terms)
+
+    def compact_cb(state):
+        """Dedup the buffer across chunks-so-far and re-pack it."""
+        cb, cursor = state
+        head, sid, _sidx, svals = _dedup_sort(
+            lax.iota(_I32, f) >= cursor, cb[:6], cb[6:]
+        )
+        pos = jnp.cumsum(head.astype(_I32)) - 1
+        dst = jnp.where(head, pos, f)
+        new_cb = tuple(
+            jnp.zeros(f, a.dtype).at[dst].set(v, mode="drop")
+            for a, v in zip(cb, (*sid, *svals))
+        )
+        return new_cb, head.sum()
+
+    def chunk_body(chunk_i, carry):
+        # fori_loop, not a Python loop: the graph stays one chunk big no
+        # matter how many chunks the frontier needs (an unrolled loop at
+        # F/chunk = 64 took minutes to compile).
+        cb, cursor, overflow, expanded, appended = carry
+        base = chunk_i * chunk_rows
+        dsl = lambda a: lax.dynamic_slice_in_dim(a, base, chunk_rows)
+        counts_s = lax.dynamic_slice(
+            frontier.counts, (base, 0), (chunk_rows, c)
+        )
+        tail_s = dsl(frontier.tail)
+        hi_s = dsl(frontier.hi)
+        lo_s = dsl(frontier.lo)
+        tok_s = dsl(frontier.tok)
+        valid_s = dsl(frontier.valid)
+        pkh_s = dsl(pk_all.hi)
+        pkl_s = dsl(pk_all.lo)
+
+        nxt, cand = jax.vmap(partial(_next_and_cands, tables))(counts_s)
+        cand = cand & valid_s[:, None]
+
+        def row_step(t, h, l, k, nxt_row):
+            def per_chain(o):
+                sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
+                return sa, va, vb
+
+            return jax.vmap(per_chain)(nxt_row)
+
+        sa, va, vb = jax.vmap(row_step)(tail_s, hi_s, lo_s, tok_s, nxt)
+        va = va & cand
+        vb = vb & cand
+
+        idx2 = lax.iota(_I32, ce2)
+        within = lax.rem(idx2, _I32(ce))
+        parent2 = within // _I32(c)  # chunk-local
+        chain2 = lax.rem(within, _I32(c))
+        fl = lambda x: x.reshape(ce)
+        parent = parent2[:ce]
+        t2 = jnp.concatenate([fl(sa.tail), tail_s[parent]])
+        h2 = jnp.concatenate([fl(sa.hash_hi), hi_s[parent]])
+        l2 = jnp.concatenate([fl(sa.hash_lo), lo_s[parent]])
+        k2 = jnp.concatenate([fl(sa.token), tok_s[parent]])
+        valid2 = jnp.concatenate([fl(va), fl(vb)])
+
+        pk2 = u64.add(
+            u64.from_arrays(pkh_s[parent2], pkl_s[parent2]),
+            u64.from_arrays(tables.pack_hi[chain2], tables.pack_lo[chain2]),
+        )
+        op2 = jnp.concatenate([fl(nxt), fl(nxt)])
+
+        head, sid, sidx, _sv = _dedup_sort(
+            ~valid2, (pk2.hi, pk2.lo, t2, h2, l2, k2)
+        )
+        u = head.sum()
+        # If this chunk's uniques do not fit behind the cursor, first merge
+        # duplicates the buffer accumulated across earlier chunks; only a
+        # still-overflowing append drops children and reports capacity.
+        cb, cursor = lax.cond(
+            cursor + u > f, compact_cb, lambda st: st, (cb, cursor)
+        )
+        # Append this chunk's unique children at the cursor (any order —
+        # the final cross-chunk sort re-orders).
+        pos = jnp.cumsum(head.astype(_I32)) - 1
+        dst = jnp.where(head & (cursor + pos < f), cursor + pos, f)
+        gparent = base + lax.rem(sidx, _I32(ce)) // _I32(c)
+        gop = op2[sidx] * 2 + (sidx >= ce).astype(_I32)
+        vals = (*sid, gparent, gop)
+        cb = tuple(
+            a.at[dst].set(v.astype(a.dtype), mode="drop")
+            for a, v in zip(cb, vals)
+        )
+        return (
+            cb,
+            jnp.minimum(cursor + u, f),
+            overflow | (cursor + u > f),
+            expanded + cand.sum(),
+            appended + u,
+        )
+
+    cb, cursor, overflow, expanded, appended = lax.fori_loop(
+        0,
+        f // chunk_rows,
+        chunk_body,
+        (cb0, jnp.zeros((), _I32), jnp.zeros((), bool), jnp.zeros((), _I32), jnp.zeros((), _I32)),
+    )
+
+    # Final cross-chunk dedup + compaction of the committed buffer.  A
+    # duplicate can only pair rows appended by different chunks; any
+    # deterministic winner preserves verdicts (identical identities are
+    # interchangeable — the witness metadata of equal rows differs only in
+    # which parent the recovered path threads through, and both are valid).
+    head, sid, _sidx, svals = _dedup_sort(
+        lax.iota(_I32, f) >= cursor, cb[:6], cb[6:]
+    )
+    s_pkh, s_pkl, s_t, s_h, s_l, s_k = sid
+    v_par, v_op = svals
+    n_unique = head.sum()
+
+    pos = jnp.cumsum(head.astype(_I32)) - 1
+    dst = jnp.where(head & (pos < f), pos, f)
+    wparent = jnp.zeros(f, _I32).at[dst].set(v_par, mode="drop")
+    wop = jnp.full(f, -1, _I32).at[dst].set(v_op, mode="drop")
+    valid_next = jnp.zeros(f, bool).at[dst].set(head, mode="drop")
+    sel_chain = jnp.zeros(f, _I32).at[dst].set(
+        tables.ops.chain_of[v_op // 2], mode="drop"
+    )
+    counts_next = jnp.where(
+        valid_next[:, None],
+        frontier.counts[wparent]
+        + (sel_chain[:, None] == lax.iota(_I32, c)[None, :]).astype(_I32),
+        0,
+    )
+    children = Frontier(
+        counts=counts_next,
+        tail=jnp.zeros(f, _U32).at[dst].set(s_t, mode="drop"),
+        hi=jnp.zeros(f, _U32).at[dst].set(s_h, mode="drop"),
+        lo=jnp.zeros(f, _U32).at[dst].set(s_l, mode="drop"),
+        tok=jnp.zeros(f, _I32).at[dst].set(s_k.astype(_I32), mode="drop"),
+        valid=valid_next,
+    )
+    return (
+        children,
+        jnp.zeros((), bool),
+        overflow,
+        # On overflow, report the appended-rows estimate (an upper bound on
+        # the layer's uniques) so the driver escalates to a fitting bucket.
+        jnp.where(overflow, jnp.maximum(n_unique, appended), n_unique),
+        expanded,
+        wparent,
+        wop,
+        jnp.ones((), _I32),
+        jnp.zeros(c, _I32),
+        jnp.zeros((), bool),
+    )
+
+
 @partial(
     jax.jit,
-    static_argnames=("allow_prune", "log_layers", "exact_pack", "sort_dedup"),
+    static_argnames=(
+        "allow_prune",
+        "log_layers",
+        "exact_pack",
+        "sort_dedup",
+        "chunk_rows",
+    ),
 )
 def run_search(
     tables: SearchTables,
@@ -788,6 +1011,7 @@ def run_search(
     log_layers: int = 0,
     exact_pack: bool = False,
     sort_dedup: bool = False,
+    chunk_rows: int = 0,
 ) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
@@ -831,18 +1055,19 @@ def run_search(
                 if log_layers
                 else partial(_fast_multi, tables, max_layers - carry.layers)
             )
-            return lax.cond(
-                fastable,
-                fast,
-                partial(
+            if chunk_rows and chunk_rows < frontier.valid.shape[0]:
+                expand = partial(
+                    _expand_layer_chunked, tables, chunk_rows=chunk_rows
+                )
+            else:
+                expand = partial(
                     _expand_layer,
                     tables,
                     allow_prune=allow_prune,
                     exact_pack=exact_pack,
                     sort_dedup=sort_dedup,
-                ),
-                fr,
-            )
+                )
+            return lax.cond(fastable, fast, expand, fr)
 
         f = frontier.valid.shape[0]
         c = frontier.counts.shape[1]
@@ -1129,6 +1354,7 @@ def check_device(
     spill_host_cap: int = 1 << 26,
     exact_pack: bool | None = None,
     sort_dedup: bool | None = None,
+    device_rows_cap: int = 0,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1165,9 +1391,18 @@ def check_device(
     O(layers x F) device memory; past the cap (or on checkpoint resume)
     the log is dropped and recovery takes over anyway.
 
+    ``device_rows_cap > max_frontier`` (exhaustive + packed-key only)
+    enables the HBM-resident middle tier: when the frontier outgrows the
+    ``max_frontier`` expansion bucket, it keeps growing on device up to
+    ``device_rows_cap`` rows, expanded in ``max_frontier``-row chunks per
+    layer (:func:`_expand_layer_chunked`) — no host round-trips.  Only
+    past ``device_rows_cap`` (or when packing is unavailable) does the
+    search concede UNKNOWN or, with ``spill=True``, hand off to host RAM.
+
     ``spill=True`` (exhaustive mode only): when the frontier outgrows
-    ``max_frontier``, spill it to host RAM and stream slabs through the
-    chip — layer by layer, each slab one compiled single-layer pass, with
+    ``max_frontier`` (and ``device_rows_cap``, if set), spill it to host
+    RAM and stream slabs through the chip — layer by layer, each slab one
+    compiled single-layer pass, with
     exact host-side dedup between layers — instead of conceding UNKNOWN.
     Out-of-core exhaustion stays conclusive (nothing is ever dropped) up
     to ``spill_host_cap`` host rows; the per-layer witness log does not
@@ -1228,6 +1463,14 @@ def check_device(
     cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
+    # HBM-resident middle tier: frontier may outgrow the expansion bucket
+    # up to big_cap rows, expanded in f_cap-row chunks (exhaustive +
+    # packed-key only; a beam run prunes at the bucket instead).
+    big_cap = (
+        _floor_pow2(device_rows_cap, 2)
+        if device_rows_cap > f_cap and not beam and xp
+        else f_cap
+    )
     f = _round_pow2(
         max(min(start_frontier, f_cap), len(enc.init_states)), 2
     )
@@ -1375,6 +1618,12 @@ def check_device(
             log_layers=_WITNESS_CHUNK if witness else 0,
             exact_pack=xp,
             sort_dedup=sd,
+            # Chunked expansion only when the big tier is eligible
+            # (exhaustive + packed key, big_cap > f_cap).  A checkpoint
+            # resumed at f > f_cap WITHOUT eligibility (beam resume, or an
+            # unpackable history whose zeroed strides would alias every
+            # identity) must run the one-shot expander at width f instead.
+            chunk_rows=f_cap if (big_cap > f_cap and f > f_cap) else 0,
         )
         # Scalar-only fetch: the frontier itself stays on device.  Pulling
         # the whole frontier back per segment (the previous design) moved
@@ -1471,13 +1720,15 @@ def check_device(
         if code == STOP_CAPACITY:
             # Capacity wall below the cap: escalate and resume from the
             # returned pre-expansion frontier (no information was lost).
-            if f < f_cap:
+            # Past f_cap the frontier keeps growing HBM-resident (chunked
+            # expansion) until big_cap.
+            if f < big_cap:
                 # Jump straight to a bucket that fits the aborted layer's
                 # children (x2 headroom) instead of stepping x4 through
                 # intermediate buckets — each distinct capacity is its own
                 # XLA program, so skipped buckets are skipped compiles.
                 need = _round_pow2(max(int(want) * 2, f * 4), 2)
-                f = min(need, f_cap)
+                f = min(need, big_cap)
                 log.debug("capacity stop: escalating frontier to %d and resuming", f)
                 frontier = _regrow_device(out.frontier, capacity=f)
                 if mesh is not None:

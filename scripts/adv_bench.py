@@ -52,6 +52,13 @@ def main() -> int:
     ap.add_argument("--skip-native", action="store_true")
     ap.add_argument("--skip-device", action="store_true")
     ap.add_argument("--frontier", type=int, default=1 << 21)
+    ap.add_argument(
+        "--device-rows",
+        type=int,
+        default=0,
+        help="HBM-resident frontier cap (chunked expansion past --frontier; "
+        "0 = off)",
+    )
     ap.add_argument("--start-frontier", type=int, default=1 << 12)
     ap.add_argument("--beam", action="store_true", help="beam instead of exhaustive")
     ap.add_argument("--spill", action="store_true", help="out-of-core past the frontier cap")
@@ -118,6 +125,7 @@ def main() -> int:
                     collect_stats=True,
                     witness=args.witness,
                     spill=args.spill,
+                    device_rows_cap=args.device_rows,
                 )
 
             def trace_ctx():
