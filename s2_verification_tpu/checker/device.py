@@ -524,8 +524,9 @@ def _dedup_sort(invalid, ident, values=()):
     in sorted space, the first (winning) row of each valid identity run.
     """
     n = invalid.shape[0]
-    out = lax.sort((invalid, *ident, lax.iota(_I32, n), *values), num_keys=8)
-    sb, sid, sidx, svals = out[0], out[1:7], out[7], out[8:]
+    nk = 2 + len(ident)  # invalid + identity words + index tiebreak
+    out = lax.sort((invalid, *ident, lax.iota(_I32, n), *values), num_keys=nk)
+    sb, sid, sidx, svals = out[0], out[1 : nk - 1], out[nk - 1], out[nk:]
     shift = lambda x: jnp.concatenate([x[:1], x[:-1]])
     same_prev = (lax.iota(_I32, n) > 0)
     for w in sid:
@@ -552,6 +553,56 @@ def _u64_sum_axis1(x: u64.U64) -> u64.U64:
     return u64.from_arrays(hi[:, 0], lo[:, 0])
 
 
+def _expand_slice(tables: SearchTables, counts_s, tail_s, hi_s, lo_s, tok_s, valid_s):
+    """Expansion preamble for one frontier slice, shared by the one-shot
+    layer and the chunked per-chunk pass (one implementation so the
+    no-effect-fork handling and index arithmetic can never diverge):
+    candidate sweep, step kernel, and the flattened per-child arrays.
+
+    Returns ``(t2, h2, l2, k2, valid2, op2, parent2, chain2, cand)`` where
+    the ``*2`` arrays have 2*rows*C lanes (slot A then slot B) and
+    ``parent2`` is slice-local.
+    """
+    fs, c = counts_s.shape
+    ops = tables.ops
+    e = fs * c
+    e2 = 2 * e
+
+    nxt, cand = jax.vmap(partial(_next_and_cands, tables))(counts_s)
+    cand = cand & valid_s[:, None]
+
+    def row_step(t, h, l, k, nxt_row):
+        def per_chain(o):
+            sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
+            return sa, va, vb
+
+        return jax.vmap(per_chain)(nxt_row)
+
+    sa, va, vb = jax.vmap(row_step)(tail_s, hi_s, lo_s, tok_s, nxt)
+    # slot A: the op's effect outcome; slot B: the no-effect fork (parent
+    # state), live only for indefinite append failures.
+    va = va & cand
+    vb = vb & cand
+
+    # Index maps from iota arithmetic, NOT repeat/tile of arange: XLA
+    # constant-folds those into O(F*C) literals embedded in the executable,
+    # which made compile time, cache size, and cache-load time scale with
+    # frontier capacity (35 MB executables at F=65536).
+    idx2 = lax.iota(_I32, e2)
+    within = lax.rem(idx2, _I32(e))
+    parent2 = within // _I32(c)
+    chain2 = lax.rem(within, _I32(c))
+    fl = lambda x: x.reshape(e)
+    parent = parent2[:e]
+    t2 = jnp.concatenate([fl(sa.tail), tail_s[parent]])
+    h2 = jnp.concatenate([fl(sa.hash_hi), hi_s[parent]])
+    l2 = jnp.concatenate([fl(sa.hash_lo), lo_s[parent]])
+    k2 = jnp.concatenate([fl(sa.token), tok_s[parent]])
+    valid2 = jnp.concatenate([fl(va), fl(vb)])
+    op2 = jnp.concatenate([fl(nxt), fl(nxt)])
+    return t2, h2, l2, k2, valid2, op2, parent2, chain2, cand
+
+
 def _expand_layer(
     tables: SearchTables,
     frontier: Frontier,
@@ -571,41 +622,18 @@ def _expand_layer(
     pre-expansion frontier holds the diagnosable configuration (False
     here)."""
     f, c = frontier.counts.shape
-    ops = tables.ops
-
-    nxt, cand = jax.vmap(partial(_next_and_cands, tables))(frontier.counts)
-    cand = cand & frontier.valid[:, None]  # [F, C]
-
-    def row_step(t, h, l, k, nxt_row):
-        def per_chain(o):
-            sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
-            return sa, va, vb
-
-        return jax.vmap(per_chain)(nxt_row)
-
-    sa, va, vb = jax.vmap(row_step)(
-        frontier.tail, frontier.hi, frontier.lo, frontier.tok, nxt
-    )  # [F, C] each; the no-effect fork's state is the parent state itself
-    va = va & cand
-    vb = vb & cand
-
     e = f * c
     e2 = 2 * e
-    # Index maps from iota arithmetic, NOT repeat/tile of arange: XLA
-    # constant-folds those into O(F*C) literals embedded in the executable,
-    # which made compile time, cache size, and cache-load time scale with
-    # frontier capacity (35 MB executables at F=65536).
     idx2 = lax.iota(_I32, e2)
-    within = lax.rem(idx2, _I32(e))
-    parent2 = within // _I32(c)
-    chain2 = lax.rem(within, _I32(c))
-    fl = lambda x: x.reshape(e)
-    parent = parent2[:e]
-    t2 = jnp.concatenate([fl(sa.tail), frontier.tail[parent]])
-    h2 = jnp.concatenate([fl(sa.hash_hi), frontier.hi[parent]])
-    l2 = jnp.concatenate([fl(sa.hash_lo), frontier.lo[parent]])
-    k2 = jnp.concatenate([fl(sa.token), frontier.tok[parent]])
-    valid2 = jnp.concatenate([fl(va), fl(vb)])
+    t2, h2, l2, k2, valid2, op2, parent2, chain2, cand = _expand_slice(
+        tables,
+        frontier.counts,
+        frontier.tail,
+        frontier.hi,
+        frontier.lo,
+        frontier.tok,
+        frontier.valid,
+    )
 
     if exact_pack:
         # Exact mixed-radix counts key (prod(chain_len+1) <= 2^64, see
@@ -721,7 +749,6 @@ def _expand_layer(
         jnp.minimum(frontier.counts.T, tables.opens_tab.shape[1] - 1),
         axis=1,
     ).sum(axis=0)  # [F]
-    op2 = jnp.concatenate([fl(nxt), fl(nxt)])  # [e2] op linearized per child
     opens2 = jnp.minimum(
         p_opens[parent2] + tables.is_indef[op2].astype(_I32), _OPENS_CAP - 1
     )
@@ -805,7 +832,11 @@ def _expand_layer_chunked(
     by a cross-chunk dedup (duplicates of rows appended by earlier chunks
     are merged) and only a still-overflowing append reports capacity —
     children incomplete, pre-expansion frontier intact, same contract as
-    the one-shot layer.  A final cross-chunk pass dedups and compacts the
+    the one-shot layer.  The fit test is conservative: the incoming
+    chunk's rows are not merged against the buffer before testing, so a
+    chunk whose rows mostly duplicate buffered ones can report capacity
+    even though the true union fits — costing an early escalation or
+    spill, never a verdict.  A final cross-chunk pass dedups and compacts the
     committed buffer.  Exhaustive only (no beam).  Returns the
     :func:`_expand_layer` 10-tuple; on overflow the n_unique element
     carries the total appended-rows estimate so the driver's
@@ -814,9 +845,7 @@ def _expand_layer_chunked(
     """
     f, c = frontier.counts.shape
     assert f % chunk_rows == 0 and chunk_rows < f
-    ops = tables.ops
-    ce = chunk_rows * c
-    ce2 = 2 * ce
+    ce = chunk_rows * c  # slot-A lanes per chunk; slot B doubles it
 
     # Children buffer: identity words + witness metadata, written densely
     # behind a cursor.  Validity of slot i is "i < cursor".
@@ -874,37 +903,13 @@ def _expand_layer_chunked(
         pkh_s = dsl(pk_all.hi)
         pkl_s = dsl(pk_all.lo)
 
-        nxt, cand = jax.vmap(partial(_next_and_cands, tables))(counts_s)
-        cand = cand & valid_s[:, None]
-
-        def row_step(t, h, l, k, nxt_row):
-            def per_chain(o):
-                sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
-                return sa, va, vb
-
-            return jax.vmap(per_chain)(nxt_row)
-
-        sa, va, vb = jax.vmap(row_step)(tail_s, hi_s, lo_s, tok_s, nxt)
-        va = va & cand
-        vb = vb & cand
-
-        idx2 = lax.iota(_I32, ce2)
-        within = lax.rem(idx2, _I32(ce))
-        parent2 = within // _I32(c)  # chunk-local
-        chain2 = lax.rem(within, _I32(c))
-        fl = lambda x: x.reshape(ce)
-        parent = parent2[:ce]
-        t2 = jnp.concatenate([fl(sa.tail), tail_s[parent]])
-        h2 = jnp.concatenate([fl(sa.hash_hi), hi_s[parent]])
-        l2 = jnp.concatenate([fl(sa.hash_lo), lo_s[parent]])
-        k2 = jnp.concatenate([fl(sa.token), tok_s[parent]])
-        valid2 = jnp.concatenate([fl(va), fl(vb)])
-
+        t2, h2, l2, k2, valid2, op2, parent2, chain2, cand = _expand_slice(
+            tables, counts_s, tail_s, hi_s, lo_s, tok_s, valid_s
+        )
         pk2 = u64.add(
             u64.from_arrays(pkh_s[parent2], pkl_s[parent2]),
             u64.from_arrays(tables.pack_hi[chain2], tables.pack_lo[chain2]),
         )
-        op2 = jnp.concatenate([fl(nxt), fl(nxt)])
 
         head, sid, sidx, _sv = _dedup_sort(
             ~valid2, (pk2.hi, pk2.lo, t2, h2, l2, k2)
@@ -1319,9 +1324,15 @@ def _compact_rows_device(fr: Frontier):
 
 @partial(jax.jit, static_argnames=("capacity",))
 def _regrow_device(fr: Frontier, *, capacity: int) -> Frontier:
-    """Re-pad a frontier into a larger capacity bucket without leaving the
-    device (escalation must not round-trip the frontier through the host)."""
+    """Re-bucket a frontier without leaving the device (escalation and
+    post-peak downsizing must not round-trip through the host): pad up,
+    or slice the dense prefix down — valid rows are always a prefix
+    (init_frontier and every expansion layer compact children to the
+    front), and callers must keep ``capacity`` at or above the live
+    count when shrinking."""
     f0, c = fr.counts.shape
+    if capacity <= f0:
+        return jax.tree.map(lambda x: x[:capacity], fr)
     pad = capacity - f0
     g1 = lambda x: jnp.concatenate([x, jnp.zeros(pad, x.dtype)])
     return Frontier(
@@ -1335,6 +1346,10 @@ def _regrow_device(fr: Frontier, *, capacity: int) -> Frontier:
 
 
 _WITNESS_CHUNK = 512
+#: layer budget per run_search segment while the frontier is above the
+#: expansion bucket — short enough for timely post-peak downsizing, long
+#: enough that segment dispatch overhead stays negligible.
+_BIG_TIER_CHUNK = 8
 
 
 def check_device(
@@ -1465,10 +1480,14 @@ def check_device(
     f_cap = _floor_pow2(max_frontier, 2)
     # HBM-resident middle tier: frontier may outgrow the expansion bucket
     # up to big_cap rows, expanded in f_cap-row chunks (exhaustive +
-    # packed-key only; a beam run prunes at the bucket instead).
+    # packed-key only; a beam run prunes at the bucket instead).  Not
+    # under a mesh: sharding already divides the expansion working set
+    # per device, and chunk slices across the sharded frontier axis would
+    # force cross-shard gathers — aggregate-HBM growth comes from the
+    # mesh itself there.
     big_cap = (
         _floor_pow2(device_rows_cap, 2)
-        if device_rows_cap > f_cap and not beam and xp
+        if device_rows_cap > f_cap and not beam and xp and mesh is None
         else f_cap
     )
     f = _round_pow2(
@@ -1610,6 +1629,12 @@ def check_device(
             layers_budget = min(layers_budget, checkpoint_every)
         if witness:
             layers_budget = min(layers_budget, _WITNESS_CHUNK)
+        if f > f_cap:
+            # Short big-tier segments: after the peak the frontier decays,
+            # and the driver can only downsize (below) at a segment
+            # boundary — full-width chunked layers over a mostly-dead
+            # frontier would otherwise dominate the post-peak wall-clock.
+            layers_budget = min(layers_budget, _BIG_TIER_CHUNK)
         out = run_search(
             tables,
             frontier,
@@ -1766,6 +1791,13 @@ def check_device(
             # from the returned post-expansion frontier, which never leaves
             # the device unless a checkpoint file asked for a host copy.
             frontier = out.frontier
+            if f > f_cap and int(live) * 4 <= f:
+                # Post-peak decay: drop back to a bucket the live prefix
+                # fits with headroom (never below the expansion bucket) so
+                # later layers stop paying full-width chunked sorts.
+                f = max(_round_pow2(max(int(live), 1) * 4, 2), f_cap)
+                log.debug("post-peak downsize: frontier bucket -> %d", f)
+                frontier = _regrow_device(frontier, capacity=f)
             if checkpoint_path is not None:
                 _snapshot(Frontier(*(asarray(x) for x in frontier)))
             continue
