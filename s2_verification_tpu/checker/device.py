@@ -2649,15 +2649,32 @@ def check_device_auto(
     witness_max_frontier: int = 0,
     spill: bool = True,
     spill_host_cap: int = 1 << 26,
+    device_rows_cap: int = 1 << 23,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
+
+    The exhaustive phase keeps the frontier HBM-resident up to
+    ``device_rows_cap`` rows (chunked expansion past ``exhaustive_cap``;
+    packed-key histories only) before handing off to the host spill — so
+    the escalation ladder is beam → in-core exhaustive → on-device
+    chunked → out-of-core.
 
     The beam and exhaustive phases use distinct checkpoint files (a beam
     snapshot must not resume an exhaustive pass, whose soundness rules
     differ); a conceded beam phase leaves a marker so a preempted
     exhaustive phase does not replay the whole beam search on restart."""
     del state_slots
+    if 0 < device_rows_cap <= exhaustive_cap:
+        # The tier only engages above the exhaustive bucket; a smaller
+        # value is indistinguishable from plain bucket search, which a
+        # caller "capping" rows would not expect silently.
+        log.warning(
+            "device_rows_cap %d <= exhaustive bucket %d: the HBM-resident "
+            "tier is disabled (use 0 to disable it explicitly)",
+            device_rows_cap,
+            exhaustive_cap,
+        )
     marker = f"{checkpoint_path}.beam.conceded" if checkpoint_path else None
     fingerprint = None
     beam_already_conceded = False
@@ -2715,6 +2732,7 @@ def check_device_auto(
         witness_max_frontier=witness_max_frontier,
         spill=spill,
         spill_host_cap=spill_host_cap,
+        device_rows_cap=device_rows_cap,
     )
     # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
     # paired with the kept exhaustive snapshot: a retry (e.g. with a larger

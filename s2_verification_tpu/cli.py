@@ -92,18 +92,26 @@ def _run_backend(
     hist: History,
     time_budget_s: float | None,
     checkpoint: str | None = None,
+    device_rows: int | None = None,
 ) -> CheckResult:
     # Budget 0 = run to completion, the reference's unbounded default
     # (CheckEventsVerbose timeout 0, main.go:606).
     unbounded = time_budget_s is not None and time_budget_s <= 0
     if unbounded:
         time_budget_s = None
-    if checkpoint is not None and (
-        backend not in ("device", "auto") or (backend == "auto" and unbounded)
-    ):
+    device_only = backend in ("device", "auto") and not (
+        backend == "auto" and unbounded
+    )
+    if checkpoint is not None and not device_only:
         log.warning(
             "-checkpoint only applies to the device search; the %s backend "
             "will not snapshot",
+            f"{backend} (unbounded CPU)" if backend == "auto" else backend,
+        )
+    if device_rows is not None and not device_only:
+        log.warning(
+            "-device-rows only applies to the device search; the %s backend "
+            "ignores it",
             f"{backend} (unbounded CPU)" if backend == "auto" else backend,
         )
     if backend == "oracle":
@@ -116,11 +124,12 @@ def _run_backend(
         from .checker.frontier import check_frontier_auto
 
         return check_frontier_auto(hist)
+    dev_kw = {} if device_rows is None else {"device_rows_cap": device_rows}
     if backend == "device":
         pin_platform()
         from .checker.device import check_device_auto
 
-        return check_device_auto(hist, checkpoint_path=checkpoint)
+        return check_device_auto(hist, checkpoint_path=checkpoint, **dev_kw)
     if backend == "auto":
         if unbounded:
             # Never concede a decidable instance: CPU runs to completion.
@@ -136,7 +145,7 @@ def _run_backend(
         pin_platform()
         from .checker.device import check_device_auto
 
-        res = check_device_auto(hist, checkpoint_path=checkpoint)
+        res = check_device_auto(hist, checkpoint_path=checkpoint, **dev_kw)
         if res.outcome != CheckOutcome.UNKNOWN or time_budget_s is not None:
             return res
         # Device caps exhausted (beam + exhaustive + spill) with no
@@ -166,7 +175,11 @@ def _cmd_check(args: argparse.Namespace) -> int:
     t0 = time.monotonic()
     try:
         res = _run_backend(
-            args.backend, checked, args.time_budget, checkpoint=args.checkpoint
+            args.backend,
+            checked,
+            args.time_budget,
+            checkpoint=args.checkpoint,
+            device_rows=args.device_rows,
         )
     except Exception as e:  # backend/environment failure, not a verdict
         from .checker.checkpoint import CheckpointError
@@ -291,6 +304,16 @@ def build_parser() -> argparse.ArgumentParser:
         "--checkpoint",
         default=None,
         help="snapshot file for long device searches (resume + preemption safety)",
+    )
+    c.add_argument(
+        "-device-rows",
+        "--device-rows",
+        type=int,
+        default=None,
+        help="device-resident frontier cap for the device search's "
+        "exhaustive phase (default 2^23; the chunked tier engages only "
+        "above the 2^20 exhaustive bucket — smaller values, or 0, disable "
+        "it)",
     )
     c.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"

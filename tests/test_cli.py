@@ -357,3 +357,24 @@ def test_immediate_failure_still_names_culprit(tmp_path):
     html_text = next((tmp_path / "v").glob("*.html")).read_text()
     assert re.search(r'class="op [^"]*refused', html_text)
     assert "refusing to linearize" in html_text
+
+
+def test_check_device_rows_flag(history_path, tmp_path):
+    """-device-rows parses and plumbs through to the device backend (the
+    chunked tier itself needs a >2^20-row frontier — far beyond a CLI
+    test — and is covered by the differential tests in test_device.py;
+    a sub-bucket value like this one warns and runs the plain search)."""
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "device",
+            "-device-rows",
+            "4096",
+            "-out-dir",
+            str(tmp_path),
+        ]
+    )
+    assert rc == 0
