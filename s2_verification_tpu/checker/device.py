@@ -553,11 +553,26 @@ def _u64_sum_axis1(x: u64.U64) -> u64.U64:
     return u64.from_arrays(hi[:, 0], lo[:, 0])
 
 
-def _expand_slice(tables: SearchTables, counts_s, tail_s, hi_s, lo_s, tok_s, valid_s):
+def _expand_slice(
+    tables: SearchTables,
+    counts_s,
+    tail_s,
+    hi_s,
+    lo_s,
+    tok_s,
+    valid_s,
+    *,
+    pallas_fold: bool = False,
+):
     """Expansion preamble for one frontier slice, shared by the one-shot
     layer and the chunked per-chunk pass (one implementation so the
     no-effect-fork handling and index arithmetic can never diverge):
     candidate sweep, step kernel, and the flattened per-child arrays.
+
+    ``pallas_fold=True`` precomputes the chain-hash folds for the whole
+    slice in one Pallas kernel call (accumulator stays in VMEM across the
+    batch; ops/fold_pallas.py) instead of the per-lane ``lax.scan``;
+    callers gate on :func:`..ops.fold_pallas.pallas_fold_eligible`.
 
     Returns ``(t2, h2, l2, k2, valid2, op2, parent2, chain2, cand)`` where
     the ``*2`` arrays have 2*rows*C lanes (slot A then slot B) and
@@ -571,14 +586,37 @@ def _expand_slice(tables: SearchTables, counts_s, tail_s, hi_s, lo_s, tok_s, val
     nxt, cand = jax.vmap(partial(_next_and_cands, tables))(counts_s)
     cand = cand & valid_s[:, None]
 
-    def row_step(t, h, l, k, nxt_row):
-        def per_chain(o):
-            sa, va, _sb, vb = step_kernel(ops, o, DeviceState(t, h, l, k))
+    if pallas_fold:
+        from ..ops.fold_pallas import fold_lanes_pallas
+
+        fh, flo = fold_lanes_pallas(
+            jnp.broadcast_to(hi_s[:, None], (fs, c)).reshape(e),
+            jnp.broadcast_to(lo_s[:, None], (fs, c)).reshape(e),
+            ops.rh_row[nxt].reshape(e),
+            ops.rh_len[nxt].reshape(e),
+            ops.rh_hi,
+            ops.rh_lo,
+            interpret=jax.default_backend() != "tpu",
+        )
+        folded = (fh.reshape(fs, c), flo.reshape(fs, c))
+    else:
+        folded = None
+
+    def row_step(t, h, l, k, nxt_row, f_row):
+        def per_chain(o, f_ch):
+            sa, va, _sb, vb = step_kernel(
+                ops,
+                o,
+                DeviceState(t, h, l, k),
+                folded=None if f_ch is None else u64.from_arrays(*f_ch),
+            )
             return sa, va, vb
 
-        return jax.vmap(per_chain)(nxt_row)
+        return jax.vmap(per_chain)(nxt_row, f_row)
 
-    sa, va, vb = jax.vmap(row_step)(tail_s, hi_s, lo_s, tok_s, nxt)
+    # folded=None flows through both vmap levels as an empty pytree, so
+    # one traversal serves both fold paths.
+    sa, va, vb = jax.vmap(row_step)(tail_s, hi_s, lo_s, tok_s, nxt, folded)
     # slot A: the op's effect outcome; slot B: the no-effect fork (parent
     # state), live only for indefinite append failures.
     va = va & cand
@@ -610,6 +648,7 @@ def _expand_layer(
     allow_prune: bool,
     exact_pack: bool = False,
     sort_dedup: bool = False,
+    pallas_fold: bool = False,
 ):
     """Expand + dedup + compact one layer.  Returns the 10-tuple
     (children, pruned, overflow, n_unique, expanded, wparent, wop,
@@ -633,6 +672,7 @@ def _expand_layer(
         frontier.lo,
         frontier.tok,
         frontier.valid,
+        pallas_fold=pallas_fold,
     )
 
     if exact_pack:
@@ -811,7 +851,11 @@ def _expand_layer(
 
 
 def _expand_layer_chunked(
-    tables: SearchTables, frontier: Frontier, *, chunk_rows: int
+    tables: SearchTables,
+    frontier: Frontier,
+    *,
+    chunk_rows: int,
+    pallas_fold: bool = False,
 ):
     """One exhaustive expansion layer over a frontier too wide to expand in
     one piece: the frontier stays device-resident at full width F while the
@@ -904,7 +948,8 @@ def _expand_layer_chunked(
         pkl_s = dsl(pk_all.lo)
 
         t2, h2, l2, k2, valid2, op2, parent2, chain2, cand = _expand_slice(
-            tables, counts_s, tail_s, hi_s, lo_s, tok_s, valid_s
+            tables, counts_s, tail_s, hi_s, lo_s, tok_s, valid_s,
+            pallas_fold=pallas_fold,
         )
         pk2 = u64.add(
             u64.from_arrays(pkh_s[parent2], pkl_s[parent2]),
@@ -1005,6 +1050,7 @@ def _expand_layer_chunked(
         "exact_pack",
         "sort_dedup",
         "chunk_rows",
+        "pallas_fold",
     ),
 )
 def run_search(
@@ -1017,6 +1063,7 @@ def run_search(
     exact_pack: bool = False,
     sort_dedup: bool = False,
     chunk_rows: int = 0,
+    pallas_fold: bool = False,
 ) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
@@ -1062,7 +1109,10 @@ def run_search(
             )
             if chunk_rows and chunk_rows < frontier.valid.shape[0]:
                 expand = partial(
-                    _expand_layer_chunked, tables, chunk_rows=chunk_rows
+                    _expand_layer_chunked,
+                    tables,
+                    chunk_rows=chunk_rows,
+                    pallas_fold=pallas_fold,
                 )
             else:
                 expand = partial(
@@ -1071,6 +1121,7 @@ def run_search(
                     allow_prune=allow_prune,
                     exact_pack=exact_pack,
                     sort_dedup=sort_dedup,
+                    pallas_fold=pallas_fold,
                 )
             return lax.cond(fastable, fast, expand, fr)
 
@@ -1370,6 +1421,7 @@ def check_device(
     exact_pack: bool | None = None,
     sort_dedup: bool | None = None,
     device_rows_cap: int = 0,
+    pallas_fold: bool | None = None,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1475,6 +1527,25 @@ def check_device(
                 "overflows the u64 packed key; using the probe table"
             )
     sd = bool(sort_dedup) and xp
+    # Pallas fold: VMEM-resident batch fold (ops/fold_pallas.py).  Same
+    # contract shape as sort_dedup: explicit True on an ineligible history
+    # refuses; the env opt-in degrades to the scan fold with a note.
+    from ..ops.fold_pallas import pallas_fold_eligible
+
+    pf_ok = pallas_fold_eligible(np.asarray(enc.rh_hi))
+    if pallas_fold and not pf_ok:
+        raise ValueError(
+            "pallas_fold=True requires a VMEM-sized record-hash table "
+            "(pallas_fold_eligible); this history's is too large"
+        )
+    if pallas_fold is None:
+        pallas_fold = os.environ.get("S2VTPU_PALLAS_FOLD") == "1"
+        if pallas_fold and not pf_ok:
+            log.debug(
+                "S2VTPU_PALLAS_FOLD=1 ignored: record-hash table too "
+                "large for VMEM; using the scan fold"
+            )
+    pf = bool(pallas_fold) and pf_ok
     cap_layers = int(enc.total_remaining) + 2
 
     f_cap = _floor_pow2(max_frontier, 2)
@@ -1541,6 +1612,7 @@ def check_device(
                 witness_requested=witness_requested,
                 exact_pack=xp,
                 sort_dedup=sd,
+                pallas_fold=pf,
             )
             if res.outcome != CheckOutcome.UNKNOWN:
                 with contextlib.suppress(FileNotFoundError):
@@ -1643,6 +1715,7 @@ def check_device(
             log_layers=_WITNESS_CHUNK if witness else 0,
             exact_pack=xp,
             sort_dedup=sd,
+            pallas_fold=pf,
             # Chunked expansion only when the big tier is eligible
             # (exhaustive + packed key, big_cap > f_cap).  A checkpoint
             # resumed at f > f_cap WITHOUT eligibility (beam resume, or an
@@ -1781,6 +1854,7 @@ def check_device(
                     witness_requested=witness_requested,
                     exact_pack=xp,
                     sort_dedup=sd,
+                    pallas_fold=pf,
                 )
                 break
             stats.pruned = True
@@ -2256,6 +2330,7 @@ def _spill_search(
     witness_requested: bool = False,
     exact_pack: bool = False,
     sort_dedup: bool = False,
+    pallas_fold: bool = False,
 ) -> CheckResult:
     """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
 
@@ -2379,6 +2454,7 @@ def _spill_search(
                 allow_prune=False,
                 exact_pack=exact_pack,
                 sort_dedup=sort_dedup,
+                pallas_fold=pallas_fold,
             )
             code, seg_layers, seg_live, seg_ac, seg_ex, accept_idx, dc = (
                 device_get(
@@ -2500,6 +2576,7 @@ def _spill_search(
                                 allow_prune=False,
                                 exact_pack=exact_pack,
                                 sort_dedup=sort_dedup,
+                                pallas_fold=pallas_fold,
                             ),
                         )
                     )

@@ -1,0 +1,170 @@
+"""The chain-hash fold as a Pallas TPU kernel.
+
+The XLA formulation of the fold (ops/xxh3.py, a ``lax.scan`` vmapped over
+expansion lanes) re-materializes the u64 accumulator carry in HBM every
+scan step: 2 x 8 bytes x lanes x batch-length of traffic per expansion
+layer — the dominant memory stream of the layer on wide frontiers.  This
+kernel keeps the accumulator in VMEM registers across the whole batch:
+each grid step loads one (8, 128) tile of lane seeds, loops the batch
+length on-core, and writes the folded result once.  Traffic drops from
+O(lanes x L) to O(lanes + R x L).
+
+The record-hash tables ride along in VMEM transposed to ``[L, R]`` (the
+per-step slice ``rh[i, :]`` is then a dynamic slice on the sublane
+dimension, the direction Mosaic supports), and the per-lane gather
+``rh[i, row]`` is a one-hot multiply-accumulate over the R ops — R is
+the number of distinct record-hash rows, which the eligibility gate
+(:func:`pallas_fold_eligible`) bounds, so the whole table fits VMEM and
+the one-hot stays cheap.  The adversarial frontier regime (few ops, huge
+frontiers — exactly where the fold bill is paid) always qualifies;
+thousand-op collector histories fall back to the scan.
+
+Bit-exactness: the kernel body reuses ops/xxh3.py's ``chain_hash``
+(uint32-pair arithmetic from ops/u64.py) unchanged, and a differential
+test pins it against the scan fold lane-for-lane.
+
+Reference for the protocol being folded: history.rs:43-45 /
+main.go:232-244 (chain_hash / foldRecordHashes).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .u64 import U64
+from .xxh3 import chain_hash
+
+__all__ = ["fold_lanes_pallas", "pallas_fold_eligible"]
+
+_LANE_TILE = 8 * 128  # one VPU tile of lanes per grid step
+
+#: VMEM budget for the kernel's resident buffers (both padded [L, R]
+#: tables plus the [8, 128, R] one-hot), kept well under the ~16 MB/core
+#: so lane tiles, accumulators, and double-buffering fit beside them.
+_MAX_VMEM_BYTES = 4 << 20
+
+
+def _kernel_footprint_bytes(r_ops: int, l_max: int) -> int:
+    """The kernel's VMEM-resident bytes for a given table shape — computed
+    on the PADDED shapes the kernel actually materializes (an un-padded
+    product bound admits skewed tables, e.g. [1, 32768], whose padded
+    [32768, 128] layout blows VMEM at Mosaic compile time)."""
+    l_pad = -(-max(l_max, 1) // 8) * 8
+    r_pad = -(-r_ops // 128) * 128
+    tables = 2 * l_pad * r_pad * 4  # rh hi + lo, u32
+    onehot = 8 * 128 * r_pad * 4
+    return tables + onehot
+
+
+def pallas_fold_eligible(rh_hi) -> bool:
+    """Whether the history's record-hash table is small enough to ride in
+    VMEM (the adversarial family always is; wide collector histories are
+    not — they take the scan fold, where the frontier is narrow anyway)."""
+    r_ops, l_max = rh_hi.shape
+    return _kernel_footprint_bytes(int(r_ops), int(l_max)) <= _MAX_VMEM_BYTES
+
+
+def _fold_kernel(r_ops: int, l_max: int):
+    def kernel(sh_ref, sl_ref, row_ref, len_ref, rhh_ref, rhl_ref, oh_ref, ol_ref):
+        rowv = row_ref[:]  # [8, 128] i32
+        lenv = len_ref[:]
+        # One-hot over the (padded) op axis, computed once per tile:
+        # [8, 128, R] — rowv never exceeds r_pad by construction.
+        r_pad = rhh_ref.shape[1]
+        onehot = (
+            rowv[:, :, None]
+            == lax.broadcasted_iota(jnp.int32, (1, 1, r_pad), 2)
+        ).astype(jnp.uint32)
+
+        def step(i, acc):
+            ah, al = acc
+            col_h = rhh_ref[i, :]  # [R] dynamic sublane slice
+            col_l = rhl_ref[i, :]
+            gh = (onehot * col_h[None, None, :]).sum(axis=2).astype(jnp.uint32)
+            gl = (onehot * col_l[None, None, :]).sum(axis=2).astype(jnp.uint32)
+            nxt = chain_hash(U64(ah, al), U64(gh, gl))
+            keep = i < lenv
+            return (
+                jnp.where(keep, nxt.hi, ah),
+                jnp.where(keep, nxt.lo, al),
+            )
+
+        ah, al = lax.fori_loop(0, l_max, step, (sh_ref[:], sl_ref[:]))
+        oh_ref[:] = ah
+        ol_ref[:] = al
+
+    return kernel
+
+
+def fold_lanes_pallas(
+    seed_hi, seed_lo, row, length, rh_hi, rh_lo, *, interpret: bool = False
+):
+    """Fold ``rh[row[i], :length[i]]`` into each lane's seed.
+
+    All lane arrays are flat ``[N]``; ``rh_hi``/``rh_lo`` are the shared
+    ``[R, L]`` padded tables (the encode layout).  Returns ``(hi, lo)``.
+    Callers gate on :func:`pallas_fold_eligible`.
+    """
+    n = seed_hi.shape[0]
+    r_ops, l_max = rh_hi.shape
+    if l_max == 0:
+        return seed_hi, seed_lo
+
+    # Lane padding to whole (8, 128) tiles; padded lanes fold op 0 with
+    # length 0 (a no-op) and are sliced away at the end.
+    n_pad = -(-n // _LANE_TILE) * _LANE_TILE
+    pad = n_pad - n
+    g = n_pad // _LANE_TILE
+
+    def lane(x, fill):
+        return (
+            jnp.concatenate([x, jnp.full(pad, fill, x.dtype)])
+            if pad
+            else x
+        ).reshape(g * 8, 128)
+
+    # Table padding: sublane axis (L) to a multiple of 8, lane axis (R)
+    # to a multiple of 128, transposed to [L, R].
+    l_pad = -(-l_max // 8) * 8
+    r_pad = -(-r_ops // 128) * 128
+    rh_t = jnp.zeros((2, l_pad, r_pad), jnp.uint32)
+    rh_t = rh_t.at[0, :l_max, :r_ops].set(rh_hi.T)
+    rh_t = rh_t.at[1, :l_max, :r_ops].set(rh_lo.T)
+
+    kernel = _fold_kernel(r_ops, l_max)
+    lane_spec = pl.BlockSpec(
+        (8, 128), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    table_spec = pl.BlockSpec(
+        (l_pad, r_pad), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    out_hi, out_lo = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[
+            lane_spec,
+            lane_spec,
+            lane_spec,
+            lane_spec,
+            table_spec,
+            table_spec,
+        ],
+        out_specs=[lane_spec, lane_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((g * 8, 128), jnp.uint32),
+            jax.ShapeDtypeStruct((g * 8, 128), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(
+        lane(seed_hi, 0),
+        lane(seed_lo, 0),
+        lane(row, 0),
+        lane(length, 0),
+        rh_t[0],
+        rh_t[1],
+    )
+    return out_hi.reshape(n_pad)[:n], out_lo.reshape(n_pad)[:n]

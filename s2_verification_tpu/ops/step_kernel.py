@@ -107,12 +107,16 @@ def states_equal(a: DeviceState, b: DeviceState):
     )
 
 
-def step_kernel(ops: DeviceOps, op_idx, state: DeviceState):
+def step_kernel(ops: DeviceOps, op_idx, state: DeviceState, folded: U64 | None = None):
     """Step one state through op ``op_idx``.
 
     Returns ``(state_a, valid_a, state_b, valid_b)``; the successor set is
     {A if valid_a} ∪ {B if valid_b} and the op linearizes here (from this
     state) iff at least one is valid.
+
+    ``folded``: the op's chain-hash fold of ``state.stream_hash``,
+    precomputed outside (the Pallas fold kernel batches it over whole
+    expansion layers); ``None`` folds inline via the ``lax.scan`` path.
     """
     is_append = ops.op_type[op_idx] == 0
     failure = ops.out_failure[op_idx]
@@ -127,13 +131,14 @@ def step_kernel(ops: DeviceOps, op_idx, state: DeviceState):
     # length; non-append rows fold nothing.  Indexed variant: gathers one
     # hash-table column per scan step so wide vmaps never materialize a
     # [lanes, batch] temp.
-    folded = fold_record_hashes_indexed(
-        state.stream_hash,
-        ops.rh_row[op_idx],
-        ops.rh_len[op_idx],
-        ops.rh_hi,
-        ops.rh_lo,
-    )
+    if folded is None:
+        folded = fold_record_hashes_indexed(
+            state.stream_hash,
+            ops.rh_row[op_idx],
+            ops.rh_len[op_idx],
+            ops.rh_hi,
+            ops.rh_lo,
+        )
     opt = DeviceState(
         tail=state.tail + ops.num_records[op_idx],
         hash_hi=folded.hi,

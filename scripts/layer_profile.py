@@ -93,6 +93,7 @@ def main() -> int:
         "--no-exact-pack", dest="exact_pack", action="store_false", default=True
     )
     ap.add_argument("--sort-dedup", action="store_true")
+    ap.add_argument("--pallas-fold", action="store_true")
     args = ap.parse_args()
 
     hist = prepare(adversarial_events(args.k, batch=args.batch, seed=0))
@@ -104,6 +105,12 @@ def main() -> int:
     sort_dedup = args.sort_dedup and xp
     if args.sort_dedup and not sort_dedup:
         print("# --sort-dedup ignored: exact packing unavailable", flush=True)
+    from s2_verification_tpu.ops.fold_pallas import pallas_fold_eligible
+    import numpy as _np
+
+    pallas_fold = args.pallas_fold and pallas_fold_eligible(_np.asarray(enc.rh_hi))
+    if args.pallas_fold and not pallas_fold:
+        print("# --pallas-fold ignored: table too large", flush=True)
     f = D._floor_pow2(args.frontier, 2)
 
     frontier, live = _grow_to_peak(enc, tables, f, xp)
@@ -111,7 +118,7 @@ def main() -> int:
     print(
         f"# backend={jax.default_backend()} k={args.k} batch={args.batch} "
         f"bucket={fc} live={live} chains={c} e2={2 * fc * c} exact_pack={xp} "
-        f"sort_dedup={sort_dedup}",
+        f"sort_dedup={sort_dedup} pallas_fold={pallas_fold}",
         flush=True,
     )
 
@@ -145,7 +152,7 @@ def main() -> int:
     # --- layer-nofold: _expand_layer with the fold stubbed out ----------
     real_step = D.step_kernel
 
-    def stub_step(ops, op_idx, state):
+    def stub_step(ops, op_idx, state, folded=None):
         # Same shapes/dtypes, no record-hash scan: successor A is a cheap
         # arithmetic twist of the parent state, both branches "valid" (the
         # dedup then sees realistic duplicate rates is not the goal —
@@ -168,6 +175,7 @@ def main() -> int:
                 allow_prune=False,
                 exact_pack=xp,
                 sort_dedup=sort_dedup,
+                pallas_fold=pallas_fold,
             )
         )
         t_nofold = _time(
@@ -184,6 +192,7 @@ def main() -> int:
             allow_prune=False,
             exact_pack=xp,
             sort_dedup=sort_dedup,
+            pallas_fold=pallas_fold,
         )
     )
     t_full = _time(

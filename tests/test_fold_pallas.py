@@ -1,0 +1,87 @@
+"""The Pallas fold kernel: bit-exactness against the scan fold, engine
+differential, and the eligibility contract."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from s2_verification_tpu.checker.device import check_device
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome
+from s2_verification_tpu.collector.adversarial import adversarial_events
+from s2_verification_tpu.ops.fold_pallas import (
+    fold_lanes_pallas,
+    pallas_fold_eligible,
+)
+from s2_verification_tpu.ops.u64 import U64
+from s2_verification_tpu.ops.xxh3 import fold_record_hashes_indexed
+
+from helpers import assert_valid_linearization
+
+
+def test_pallas_fold_bit_exact_vs_scan():
+    rng = np.random.default_rng(7)
+    r_ops, l_max, n = 13, 100, 5000
+    rh_hi = jnp.asarray(rng.integers(0, 1 << 32, (r_ops, l_max), dtype=np.uint32))
+    rh_lo = jnp.asarray(rng.integers(0, 1 << 32, (r_ops, l_max), dtype=np.uint32))
+    seed_hi = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    seed_lo = jnp.asarray(rng.integers(0, 1 << 32, n, dtype=np.uint32))
+    row = jnp.asarray(rng.integers(0, r_ops, n, dtype=np.int32))
+    length = jnp.asarray(rng.integers(0, l_max + 1, n, dtype=np.int32))
+
+    ref = jax.vmap(
+        lambda sh, sl, r, ln: fold_record_hashes_indexed(
+            U64(sh, sl), r, ln, rh_hi, rh_lo
+        )
+    )(seed_hi, seed_lo, row, length)
+    got_hi, got_lo = fold_lanes_pallas(
+        seed_hi, seed_lo, row, length, rh_hi, rh_lo, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ref.hi), np.asarray(got_hi))
+    np.testing.assert_array_equal(np.asarray(ref.lo), np.asarray(got_lo))
+
+
+def test_device_pallas_fold_differential():
+    """pallas_fold=True must not change verdicts, search shape, or the
+    witness — across the one-shot and chunked tiers."""
+    for k, unsat in ((6, False), (5, True)):
+        hist = prepare(adversarial_events(k, batch=4, seed=1, unsatisfiable=unsat))
+        # Baseline pinned to the scan fold: with S2VTPU_PALLAS_FOLD=1 in
+        # the environment an unset flag would resolve to the Pallas path
+        # and the differential would compare the kernel against itself.
+        a = check_device(
+            hist, max_frontier=4096, start_frontier=16, beam=False,
+            collect_stats=True, pallas_fold=False,
+        )
+        b = check_device(
+            hist, max_frontier=4096, start_frontier=16, beam=False,
+            collect_stats=True, pallas_fold=True,
+        )
+        assert a.outcome == b.outcome
+        assert a.stats.expanded == b.stats.expanded
+        assert a.stats.max_frontier == b.stats.max_frontier
+        if a.outcome == CheckOutcome.OK:
+            assert sorted(a.final_states) == sorted(b.final_states)
+            assert_valid_linearization(hist, b.linearization)
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    c = check_device(
+        hist, max_frontier=64, start_frontier=16, beam=False,
+        device_rows_cap=4096, pallas_fold=True,
+    )
+    assert c.outcome == CheckOutcome.OK
+    assert_valid_linearization(hist, c.linearization)
+
+
+def test_pallas_fold_refused_when_table_too_large():
+    """Explicit pallas_fold=True on an oversized record-hash table refuses
+    (the env opt-in degrades instead), matching the sort_dedup contract."""
+    # 4000-record batches: the padded [4000, 128] u32 table pair alone
+    # exceeds the kernel's VMEM budget.
+    hist = prepare(adversarial_events(5, batch=4000, seed=0))
+    from s2_verification_tpu.models.encode import encode_history
+
+    assert not pallas_fold_eligible(np.asarray(encode_history(hist).rh_hi))
+    with pytest.raises(ValueError, match="pallas_fold"):
+        check_device(hist, max_frontier=64, start_frontier=16, pallas_fold=True)
