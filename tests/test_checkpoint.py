@@ -241,3 +241,66 @@ def test_spill_checkpoint_resume(tmp_path):
         hist, max_frontier=32, start_frontier=32, beam=False, spill=True
     )
     assert fresh.outcome == CheckOutcome.OK
+
+
+def test_chunked_tier_checkpoint_resume(tmp_path):
+    """Preempt a big-tier (chunked-expansion) search mid-run, then resume —
+    including with a SMALLER expansion bucket, the resume-at-f>f_cap
+    shape whose gating routes back into the chunked expander."""
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(6, batch=4, seed=1))
+    want = check(hist).outcome
+    ck = str(tmp_path / "big.ckpt")
+
+    calls = {"n": 0}
+    import s2_verification_tpu.checker.device as dev
+
+    real_run = dev.run_search
+
+    def interrupting(*a, **kw):
+        calls["n"] += 1
+        out = real_run(*a, **kw)
+        # Let escalation carry the frontier past max_frontier first, then
+        # preempt inside the chunked regime.
+        if calls["n"] == 6:
+            raise KeyboardInterrupt
+        return out
+
+    dev.run_search = interrupting
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            check_device(
+                hist,
+                beam=False,
+                max_frontier=64,
+                start_frontier=16,
+                device_rows_cap=4096,
+                checkpoint_path=ck,
+                checkpoint_every=1,
+            )
+    finally:
+        dev.run_search = real_run
+
+    assert os.path.exists(ck)
+    saved = load_checkpoint(ck)
+    assert saved.f > 64  # the snapshot is from the big tier
+
+    # Resume with a smaller bucket than the snapshot width: f > f_cap from
+    # the first segment, still chunked-eligible.
+    res = check_device(
+        hist,
+        beam=False,
+        max_frontier=32,
+        start_frontier=16,
+        device_rows_cap=4096,
+        checkpoint_path=ck,
+        checkpoint_every=4,
+    )
+    assert res.outcome == want
+    assert not os.path.exists(ck)
+    if res.outcome.name == "OK":
+        from helpers import assert_valid_linearization as _avl
+
+        assert res.linearization is not None
+        _avl(hist, res.linearization)
