@@ -2726,7 +2726,7 @@ def check_device_auto(
     witness_max_frontier: int = 0,
     spill: bool = True,
     spill_host_cap: int = 1 << 26,
-    device_rows_cap: int = 1 << 23,
+    device_rows_cap: int | None = None,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
@@ -2735,13 +2735,27 @@ def check_device_auto(
     ``device_rows_cap`` rows (chunked expansion past ``exhaustive_cap``;
     packed-key histories only) before handing off to the host spill — so
     the escalation ladder is beam → in-core exhaustive → on-device
-    chunked → out-of-core.
+    chunked → out-of-core.  The default is backend-aware (measured,
+    BASELINE.md): 2^23 rows on any accelerator backend, whose host
+    round-trips are the expensive resource, and 0 (straight to spill) on
+    the CPU backend — there "device" and host memory are the same RAM so
+    the spill's round-trips are free while the chunked tier's per-chunk
+    sorts are not (exhaustion sweep: 4 744 s chunked vs 4 117 s
+    spilled).  With ``spill=False`` the CPU backend keeps the tier: it
+    is then the last conclusive rung.
 
     The beam and exhaustive phases use distinct checkpoint files (a beam
     snapshot must not resume an exhaustive pass, whose soundness rules
     differ); a conceded beam phase leaves a marker so a preempted
     exhaustive phase does not replay the whole beam search on restart."""
     del state_slots
+    if device_rows_cap is None:
+        # Accelerators (anything but the cpu backend) keep the tier: their
+        # host round-trips are the expensive resource.  The cpu backend
+        # skips it only when the spill can take over — with spill=False
+        # the tier is the last conclusive rung, so keep it there too.
+        on_cpu = jax.default_backend() == "cpu"
+        device_rows_cap = 0 if (on_cpu and spill) else 1 << 23
     if 0 < device_rows_cap <= exhaustive_cap:
         # The tier only engages above the exhaustive bucket; a smaller
         # value is indistinguishable from plain bucket search, which a
