@@ -1,0 +1,67 @@
+#!/bin/bash
+# On-chip measurement runbook: waits for the TPU tunnel to answer, then
+# measures the round's full matrix — bench headline, the k=10 dedup/fold
+# variants, layer-cost apportionment, k=11/k=12 through the HBM-resident
+# chunked tier, the unsat exhaustion side, and the collector-history
+# table.  Every result lands under $OUT.  Designed to be started detached
+# (setsid nohup ...) the moment a round begins, so a tunnel outage costs
+# zero measurement time when it ends.
+#
+# Env knobs: OUT (default /tmp/onchip_r3), PROBES (default 200 x ~5.5min),
+# SKIP_WAIT=1 (assume the chip is already up).
+set -u
+OUT="${OUT:-/tmp/onchip_r3}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.." || exit 1
+log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/runbook.log"; }
+
+if [ "${SKIP_WAIT:-0}" != "1" ]; then
+  log "waiting for TPU..."
+  ok=0
+  n="${PROBES:-200}"
+  # The probe must ASSERT a tpu platform inside python: a CPU-fallback
+  # init also exits 0, and the captured warning text can even contain the
+  # string "TPU" — rc is the only trustworthy signal.
+  for i in $(seq 1 "$n"); do
+    timeout 150 python -c "
+import jax, jax.numpy as jnp
+ds = jax.devices()
+assert any(d.platform == 'tpu' for d in ds), ds
+print(ds); print(jnp.arange(8).sum())
+" > "$OUT/probe.last" 2>&1 && { ok=1; break; }
+    [ "$i" -lt "$n" ] && sleep 180
+  done
+  [ "$ok" = 1 ] || { log "TPU never answered; giving up"; exit 1; }
+fi
+log "TPU is up; starting sequence"
+
+log "1. bench.py (headline + adversarial line, isolated child)"
+timeout 3600 python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"; log "bench rc=$?"
+
+log "2. adv_bench k=10 packed+probe dedup"
+timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
+
+log "3. adv_bench k=10 sort dedup"
+S2VTPU_SORT_DEDUP=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_sort.out" 2>&1; log "rc=$?"
+
+log "4. adv_bench k=10 pallas fold (and pallas+sort)"
+S2VTPU_PALLAS_FOLD=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_pallas.out" 2>&1; log "rc=$?"
+S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_pallas_sort.out" 2>&1; log "rc=$?"
+
+log "5. layer_profile k=10: probe / sort / pallas"
+timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 > "$OUT/prof_probe.out" 2>&1; log "prof probe rc=$?"
+timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 --sort-dedup > "$OUT/prof_sort.out" 2>&1; log "prof sort rc=$?"
+timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 --pallas-fold > "$OUT/prof_pallas.out" 2>&1; log "prof pallas rc=$?"
+
+log "6. adv_bench k=11 (big tier)"
+timeout 3600 python scripts/adv_bench.py 11 --skip-oracle --skip-native --device-rows 16777216 > "$OUT/k11.out" 2>&1; log "rc=$?"
+
+log "7. adv_bench k=12 (big tier, witness)"
+timeout 5400 python scripts/adv_bench.py 12 --skip-oracle --skip-native --frontier 2097152 --device-rows 16777216 --witness --once > "$OUT/k12.out" 2>&1; log "rc=$?"
+
+log "8. unsat k=9,10 (big tier)"
+timeout 7200 python scripts/adv_bench.py 9,10 --unsat --skip-oracle --skip-native --device-rows 16777216 --once > "$OUT/unsat.out" 2>&1; log "rc=$?"
+
+log "9. table_bench (collector-history table)"
+timeout 3600 python scripts/table_bench.py > "$OUT/table.out" 2>&1; log "rc=$?"
+log "SEQUENCE COMPLETE"
