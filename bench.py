@@ -400,16 +400,7 @@ def north_star() -> int:
     # hang — e.g. a TPU tunnel dropping mid-run).
     target_s = 10.0  # BASELINE.json north star for this config
     value = n_ops / dev_s
-    # Machine-readable backend marker: automated consumers must be able to
-    # tell an on-chip measurement from the host-cores fallback without
-    # parsing stderr.
-    import jax
-
-    backend = (
-        "cpu-fallback"
-        if os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1"
-        else jax.default_backend()
-    )
+    backend = _backend_marker()
     print(
         json.dumps(
             {
@@ -429,6 +420,19 @@ def north_star() -> int:
         except Exception as e:  # auxiliary line must never kill the run
             print(f"# adversarial line failed: {e!r}", file=sys.stderr)
     return 0
+
+
+def _backend_marker() -> str:
+    """Machine-readable provenance for every JSON metric line: the JAX
+    backend the measurement ran on, or ``cpu-fallback`` when this process
+    is the host-cores fallback child."""
+    import jax
+
+    return (
+        "cpu-fallback"
+        if os.environ.get("S2VTPU_BENCH_CPU_CHILD") == "1"
+        else jax.default_backend()
+    )
 
 
 def adversarial_line() -> None:
@@ -516,6 +520,7 @@ def adversarial_line() -> None:
                     "vs_baseline": round(native_wall / dev_s, 1)
                     if native_wall is not None
                     else 0.0,
+                    "backend": _backend_marker(),
                 }
             ),
             file=sys.stderr,

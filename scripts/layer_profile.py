@@ -168,6 +168,10 @@ def main() -> int:
 
     D.step_kernel = stub_step
     try:
+        # pallas_fold stays False here: the Pallas kernel runs inside
+        # _expand_slice regardless of the step stub, so passing it through
+        # would leave fold work in the "nofold" baseline and report ~0 fold
+        # share for that variant.
         layer_nofold = jax.jit(
             partial(
                 D._expand_layer,
@@ -175,7 +179,7 @@ def main() -> int:
                 allow_prune=False,
                 exact_pack=xp,
                 sort_dedup=sort_dedup,
-                pallas_fold=pallas_fold,
+                pallas_fold=False,
             )
         )
         t_nofold = _time(
