@@ -1,0 +1,170 @@
+"""Crash-resilient driver for long on-chip runs.
+
+The axon TPU worker dies (rather than raising RESOURCE_EXHAUSTED) on HBM
+exhaustion, and a dead tunnel makes backend *init* hang instead of error.
+A long measurement therefore needs three bounds the reference never did
+(its CPU engine can't take the machine down —
+/root/reference/rust/s2-verification has no analog):
+
+1. the measurement runs in a **bounded child** (crash -> nonzero rc,
+   hang -> timeout + process-group kill);
+2. between attempts the backend is **probed** in its own bounded child
+   until the tunnel answers again (init hangs are unkillable from inside
+   the process — SIGALRM cannot interrupt the blocking C init);
+3. each relaunch **resumes from the search checkpoint**
+   (``check_device(checkpoint_path=...)``, checker/checkpoint.py), so a
+   worker crash costs one segment, not the run.
+
+``drive()`` is the generic loop; adv_bench.py --resilient and
+scripts/onchip_runbook.sh use it so the measurement matrix survives
+worker death without a human.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Callable, Sequence
+
+__all__ = ["DriveOutcome", "drive", "default_probe_cmd"]
+
+#: Probe child source: init the backend honoring an explicit JAX_PLATFORMS
+#: pin through the config API (the axon sitecustomize hook overrides the
+#: env var), run one tiny computation, and — when unpinned — assert a TPU
+#: platform is actually present: a CPU-fallback init also exits 0, so rc
+#: alone would lie.
+_PROBE_CODE = """\
+import os, jax
+p = os.environ.get('JAX_PLATFORMS')
+if p: jax.config.update('jax_platforms', p)
+ds = jax.devices()
+if not p:
+    assert any(d.platform == 'tpu' for d in ds), ds
+import jax.numpy as jnp
+print(jnp.arange(8).sum())
+"""
+
+
+def default_probe_cmd() -> list[str]:
+    return [sys.executable, "-c", _PROBE_CODE]
+
+
+@dataclasses.dataclass
+class DriveOutcome:
+    ok: bool
+    attempts: int
+    last_rc: int | None  #: None when the last attempt was killed on timeout
+    note: str
+
+
+def _kill_tree(child: subprocess.Popen) -> None:
+    with contextlib.suppress(ProcessLookupError):
+        os.killpg(child.pid, signal.SIGKILL)
+    with contextlib.suppress(Exception):
+        child.wait(timeout=30)
+
+
+def drive(
+    cmd: Sequence[str],
+    *,
+    done: Callable[[], bool],
+    attempt_timeout_s: float = 3600.0,
+    max_restarts: int = 8,
+    probe_cmd: Sequence[str] | None = None,
+    probe_timeout_s: float = 150.0,
+    probe_interval_s: float = 180.0,
+    max_probes: int = 120,
+    log: Callable[[str], None] | None = None,
+) -> DriveOutcome:
+    """Run ``cmd`` in a bounded child until ``done()`` reports a conclusive
+    result, restarting through crashes and hangs.
+
+    ``cmd`` must be idempotent-with-progress: each invocation resumes from
+    whatever persistent state (checkpoint) the previous attempt left.
+    ``done()`` is the only success signal — a zero exit without ``done()``
+    counts as a failed attempt (the child died before writing its result).
+    ``probe_cmd`` (``None`` = no probing, e.g. host-backend tests) gates
+    each relaunch on the backend answering again; the probe child is
+    bounded too, because a dead tunnel hangs init.
+    """
+    say = log or (lambda s: print(f"# resilient: {s}", file=sys.stderr, flush=True))
+    attempts = 0
+    last_rc: int | None = None
+    current: list[subprocess.Popen | None] = [None]
+
+    # The child runs in its own session (so a kill reaches its whole tree),
+    # which also detaches it from an outer `timeout` bounding THIS process:
+    # forward SIGTERM so the step's outer bound never strands an orphan
+    # holding the device.
+    def _on_term(signum, frame):
+        if current[0] is not None:
+            _kill_tree(current[0])
+        raise SystemExit(128 + signum)
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:  # non-main thread (tests): no handler, no orphankill
+        prev = None
+    try:
+        while attempts <= max_restarts:
+            attempts += 1
+            say(f"attempt {attempts}: {' '.join(cmd)}")
+            child = subprocess.Popen(list(cmd), start_new_session=True)
+            current[0] = child
+            try:
+                last_rc = child.wait(timeout=attempt_timeout_s)
+            except subprocess.TimeoutExpired:
+                _kill_tree(child)
+                last_rc = None
+                say(f"attempt {attempts} hung >{attempt_timeout_s:.0f}s; killed")
+            finally:
+                current[0] = None
+            if done():
+                return DriveOutcome(True, attempts, last_rc, "conclusive")
+            if last_rc is not None:
+                say(f"attempt {attempts} exited rc={last_rc} without a result")
+            if attempts > max_restarts:
+                break
+            if probe_cmd is not None and not _wait_for_backend(
+                probe_cmd, probe_timeout_s, probe_interval_s, max_probes, say
+            ):
+                return DriveOutcome(
+                    False, attempts, last_rc, "backend never answered between attempts"
+                )
+        return DriveOutcome(False, attempts, last_rc, "restart budget exhausted")
+    finally:
+        if prev is not None:
+            signal.signal(signal.SIGTERM, prev)
+
+
+def _wait_for_backend(
+    probe_cmd: Sequence[str],
+    probe_timeout_s: float,
+    probe_interval_s: float,
+    max_probes: int,
+    say: Callable[[str], None],
+) -> bool:
+    for i in range(1, max_probes + 1):
+        probe = subprocess.Popen(
+            list(probe_cmd),
+            start_new_session=True,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            rc = probe.wait(timeout=probe_timeout_s)
+        except subprocess.TimeoutExpired:
+            _kill_tree(probe)
+            rc = None
+        if rc == 0:
+            say(f"backend answered on probe {i}")
+            return True
+        if i < max_probes:
+            time.sleep(probe_interval_s)
+    say(f"backend dead after {max_probes} probes")
+    return False

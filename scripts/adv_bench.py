@@ -74,7 +74,52 @@ def main() -> int:
         metavar="DIR",
         help="wrap the steady device run in jax.profiler.trace(DIR)",
     )
+    ap.add_argument(
+        "--checkpoint",
+        metavar="BASE",
+        help="snapshot the device search at BASE.k{K}[u] (resumes if the "
+        "file exists; suffixed per k so multi-k runs never collide)",
+    )
+    ap.add_argument(
+        "--checkpoint-every",
+        type=int,
+        default=512,
+        help="layers between snapshots (smaller = cheaper crash, more IO)",
+    )
+    ap.add_argument(
+        "--result-json",
+        metavar="BASE",
+        help="write each k's device result to BASE.k{K}[u].json (atomic; "
+        "the resilient driver's conclusiveness signal)",
+    )
+    ap.add_argument(
+        "--resilient",
+        action="store_true",
+        help="drive each k in a bounded child with checkpoint auto-resume: "
+        "survives TPU worker crashes, mid-run hangs, and tunnel outages "
+        "(checker/resilient.py)",
+    )
+    ap.add_argument("--attempt-timeout", type=float, default=3600.0)
+    ap.add_argument("--max-restarts", type=int, default=4)
+    ap.add_argument(
+        "--no-probe",
+        action="store_true",
+        help="resilient mode: relaunch immediately instead of waiting for "
+        "the backend to answer a probe",
+    )
+    ap.add_argument("--probe-interval", type=float, default=180.0)
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument(
+        "--max-probes",
+        type=int,
+        default=20,
+        help="resilient mode: probes per outage before giving up (bounds "
+        "a dead-tunnel stall to ~max-probes x probe-interval per gap)",
+    )
     args = ap.parse_args()
+
+    if args.resilient:
+        return _resilient(args)
 
     for k in [int(x) for x in args.ks.split(",")]:
         hist = prepare(
@@ -116,6 +161,13 @@ def main() -> int:
 
             from s2_verification_tpu.checker.device import check_device
 
+            ck = _per_k(args.checkpoint, k, args.unsat)
+            if ck:
+                if os.path.dirname(ck):
+                    os.makedirs(os.path.dirname(ck), exist_ok=True)
+                if os.environ.get("S2VTPU_TEST_CRASH_ON_CHECKPOINT") == "1":
+                    _arm_crash_on_checkpoint(ck)
+
             def run_device():
                 return check_device(
                     hist,
@@ -126,6 +178,8 @@ def main() -> int:
                     witness=args.witness,
                     spill=args.spill,
                     device_rows_cap=args.device_rows,
+                    checkpoint_path=ck,
+                    checkpoint_every=args.checkpoint_every,
                 )
 
             def trace_ctx():
@@ -153,6 +207,7 @@ def main() -> int:
                 f"layers={st.layers} max_live={st.max_frontier} expanded={st.expanded}",
                 flush=True,
             )
+            witness_valid = None
             if args.witness and r.outcome.name == "OK":
                 from s2_verification_tpu.models.stream import INIT_STATE, step_set
 
@@ -172,6 +227,7 @@ def main() -> int:
                         if not states:
                             ok = False
                             break
+                witness_valid = bool(ok)
                 print(
                     f"witness k={k}: "
                     + (
@@ -181,7 +237,150 @@ def main() -> int:
                     ),
                     flush=True,
                 )
+            res_path = _per_k(args.result_json, k, args.unsat, ".json")
+            if res_path:
+                if os.path.dirname(res_path):
+                    os.makedirs(os.path.dirname(res_path), exist_ok=True)
+                _write_result(
+                    res_path,
+                    {
+                        "k": k,
+                        "unsat": args.unsat,
+                        "outcome": r.outcome.name,
+                        "warm_s": round(warm, 3),
+                        "steady_s": round(steady, 3),
+                        "layers": st.layers,
+                        "max_live": st.max_frontier,
+                        "expanded": st.expanded,
+                        "witness_valid": witness_valid,
+                    },
+                )
     return 0
+
+
+def _per_k(base: str | None, k: int, unsat: bool, ext: str = "") -> str | None:
+    """Per-k artifact path: a single --checkpoint/--result-json base must
+    never be shared across ks (a leftover snapshot from one k would abort
+    the next with a fingerprint mismatch; results would overwrite)."""
+    if not base:
+        return None
+    return f"{base}.k{k}{'u' if unsat else ''}{ext}"
+
+
+def _write_result(path: str, payload: dict) -> None:
+    """Atomic write: the resilient driver treats the file's existence as
+    'this k concluded' — a torn half-write must be impossible."""
+    import json
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _arm_crash_on_checkpoint(checkpoint_path: str) -> None:
+    """Test hook (S2VTPU_TEST_CRASH_ON_CHECKPOINT=1): SIGKILL this process
+    the moment the search writes its first checkpoint — a faithful stand-in
+    for the axon worker dying mid-run (no atexit, no cleanup).  Only arms
+    when the checkpoint does NOT yet exist, so the resumed attempt runs to
+    completion instead of dying in the same place forever."""
+    import signal
+    import threading
+
+    if os.path.exists(checkpoint_path):
+        return
+
+    def watch():
+        while not os.path.exists(checkpoint_path):
+            time.sleep(0.02)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+def _resilient(args) -> int:
+    """Parent mode: drive each k as a bounded, checkpointed child of this
+    same script, restarting through worker crashes/hangs and waiting out
+    tunnel outages between attempts (checker/resilient.py)."""
+    import json
+    import tempfile
+
+    from s2_verification_tpu.checker.resilient import default_probe_cmd, drive
+
+    base = args.checkpoint or os.path.join(
+        tempfile.gettempdir(), f"s2vtpu_adv_{os.getpid()}"
+    )
+    if os.path.dirname(base):
+        os.makedirs(os.path.dirname(base), exist_ok=True)
+    here = os.path.abspath(__file__)
+    failed = 0
+    for k in [int(x) for x in args.ks.split(",")]:
+        ck = _per_k(base, k, args.unsat)
+        res_path = _per_k(base, k, args.unsat, ".json")
+        # A stale snapshot from an aborted earlier run (other batch/seed or
+        # an older format) would raise the same CheckpointError on every
+        # attempt — a deterministic failure the restart loop must not burn
+        # its budget on.  This run owns the base path: start clean.
+        for stale in (res_path, ck, f"{ck}.spill.npz"):
+            if os.path.exists(stale):
+                os.remove(stale)
+        cmd = [
+            sys.executable,
+            here,
+            str(k),
+            "--batch", str(args.batch),
+            "--seed", str(args.seed),
+            "--skip-oracle",
+            "--skip-native",
+            "--frontier", str(args.frontier),
+            "--start-frontier", str(args.start_frontier),
+            "--device-rows", str(args.device_rows),
+            "--native-budget", str(args.native_budget),
+            "--checkpoint", base,
+            "--checkpoint-every", str(args.checkpoint_every),
+            "--result-json", base,
+        ]
+        if args.applied is not None:
+            cmd += ["--applied", str(args.applied)]
+        for flag, on in (
+            ("--unsat", args.unsat),
+            ("--beam", args.beam),
+            ("--spill", args.spill),
+            ("--witness", args.witness),
+            ("--once", args.once),
+        ):
+            if on:
+                cmd.append(flag)
+        t0 = time.monotonic()
+        out = drive(
+            cmd,
+            done=lambda p=res_path: os.path.exists(p),
+            attempt_timeout_s=args.attempt_timeout,
+            max_restarts=args.max_restarts,
+            probe_cmd=None if args.no_probe else default_probe_cmd(),
+            probe_timeout_s=args.probe_timeout,
+            probe_interval_s=args.probe_interval,
+            max_probes=args.max_probes,
+        )
+        wall = time.monotonic() - t0
+        if out.ok:
+            with open(res_path) as f:
+                res = json.load(f)
+            print(
+                f"resilient k={k}: {res['outcome']:8s} total_wall={wall:8.3f}s "
+                f"attempts={out.attempts} steady={res['steady_s']}s "
+                f"layers={res['layers']} witness_valid={res['witness_valid']}",
+                flush=True,
+            )
+        else:
+            failed += 1
+            print(
+                f"resilient k={k}: FAILED ({out.note}) total_wall={wall:8.3f}s "
+                f"attempts={out.attempts} last_rc={out.last_rc}",
+                flush=True,
+            )
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

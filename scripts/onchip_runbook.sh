@@ -7,11 +7,17 @@
 # (setsid nohup ...) the moment a round begins, so a tunnel outage costs
 # zero measurement time when it ends.
 #
-# Env knobs: OUT (default /tmp/onchip_r3), PROBES (default 200 x ~5.5min),
+# The adversarial steps run under adv_bench --resilient: each k is a
+# bounded, checkpointed child that auto-resumes through axon worker
+# crashes (SIGKILL on HBM OOM) and waits out tunnel outages between
+# attempts (checker/resilient.py) — a crash costs one segment, not the
+# matrix.
+#
+# Env knobs: OUT (default /tmp/onchip_r4), PROBES (default 200 x ~5.5min),
 # SKIP_WAIT=1 (assume the chip is already up).
 set -u
-OUT="${OUT:-/tmp/onchip_r3}"
-mkdir -p "$OUT"
+OUT="${OUT:-/tmp/onchip_r4}"
+mkdir -p "$OUT" "$OUT/ck"
 cd "$(dirname "$0")/.." || exit 1
 log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/runbook.log"; }
 
@@ -35,32 +41,37 @@ print(ds); print(jnp.arange(8).sum())
 fi
 log "TPU is up; starting sequence"
 
+# Resilient steps: bounded attempts + bounded probe-wait per outage
+# (20 x 120s = ~40min per gap), and an OUTER timeout per step so one
+# dead-tunnel step can never stall the serialized matrix for a day.
+RES="--resilient --max-restarts 3 --probe-interval 120 --max-probes 20 --skip-oracle --skip-native"
+
 log "1. bench.py (headline + adversarial line, isolated child)"
 timeout 3600 python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"; log "bench rc=$?"
 
 log "2. adv_bench k=10 packed+probe dedup"
-timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
+timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/probe" > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
 
 log "3. adv_bench k=10 sort dedup"
-S2VTPU_SORT_DEDUP=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_sort.out" 2>&1; log "rc=$?"
+S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/sort" > "$OUT/k10_sort.out" 2>&1; log "rc=$?"
 
 log "4. adv_bench k=10 pallas fold (and pallas+sort)"
-S2VTPU_PALLAS_FOLD=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_pallas.out" 2>&1; log "rc=$?"
-S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1 timeout 1800 python scripts/adv_bench.py 10 --skip-oracle --skip-native > "$OUT/k10_pallas_sort.out" 2>&1; log "rc=$?"
+S2VTPU_PALLAS_FOLD=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/pallas" > "$OUT/k10_pallas.out" 2>&1; log "rc=$?"
+S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/psort" > "$OUT/k10_pallas_sort.out" 2>&1; log "rc=$?"
 
 log "5. layer_profile k=10: probe / sort / pallas"
 timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 > "$OUT/prof_probe.out" 2>&1; log "prof probe rc=$?"
 timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 --sort-dedup > "$OUT/prof_sort.out" 2>&1; log "prof sort rc=$?"
 timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 --pallas-fold > "$OUT/prof_pallas.out" 2>&1; log "prof pallas rc=$?"
 
-log "6. adv_bench k=11 (big tier)"
-timeout 3600 python scripts/adv_bench.py 11 --skip-oracle --skip-native --device-rows 16777216 > "$OUT/k11.out" 2>&1; log "rc=$?"
+log "6. adv_bench k=11 (big tier, resilient)"
+timeout 14400 python scripts/adv_bench.py 11 $RES --attempt-timeout 3600 --device-rows 16777216 --checkpoint "$OUT/ck/k11" > "$OUT/k11.out" 2>&1; log "rc=$?"
 
-log "7. adv_bench k=12 (big tier, witness)"
-timeout 5400 python scripts/adv_bench.py 12 --skip-oracle --skip-native --frontier 2097152 --device-rows 16777216 --witness --once > "$OUT/k12.out" 2>&1; log "rc=$?"
+log "7. adv_bench k=12 (big tier, witness, resilient)"
+timeout 21600 python scripts/adv_bench.py 12 $RES --attempt-timeout 5400 --frontier 2097152 --device-rows 16777216 --witness --once --checkpoint "$OUT/ck/k12" > "$OUT/k12.out" 2>&1; log "rc=$?"
 
-log "8. unsat k=9,10 (big tier)"
-timeout 7200 python scripts/adv_bench.py 9,10 --unsat --skip-oracle --skip-native --device-rows 16777216 --once > "$OUT/unsat.out" 2>&1; log "rc=$?"
+log "8. unsat k=9,10 (big tier, resilient)"
+timeout 14400 python scripts/adv_bench.py 9,10 --unsat $RES --attempt-timeout 3600 --device-rows 16777216 --once --checkpoint "$OUT/ck/unsat" > "$OUT/unsat.out" 2>&1; log "rc=$?"
 
 log "9. table_bench (collector-history table)"
 timeout 3600 python scripts/table_bench.py > "$OUT/table.out" 2>&1; log "rc=$?"

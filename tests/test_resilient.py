@@ -1,0 +1,145 @@
+"""Crash-resilience of long device runs (checker/resilient.py).
+
+The axon TPU worker dies outright on HBM exhaustion and a dead tunnel
+hangs backend init; the resilient driver must turn both into "one lost
+segment + auto-resume".  Unit tests drive fake children through the
+crash/hang/success shapes; the integration test kills a real adv_bench
+device search (SIGKILL, no cleanup — a faithful worker death) at its
+first checkpoint and requires the relaunch to resume from that
+checkpoint to a conclusive verdict.  No reference analog: the CPU
+engine there cannot take its own machine down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from s2_verification_tpu.checker.resilient import DriveOutcome, drive
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _script(tmp_path, body: str) -> list[str]:
+    p = tmp_path / "child.py"
+    p.write_text(textwrap.dedent(body))
+    return [sys.executable, str(p)]
+
+
+def test_drive_crash_once_then_resume(tmp_path):
+    """First attempt dies before writing the result; second concludes."""
+    marker = tmp_path / "progress"
+    result = tmp_path / "result"
+    cmd = _script(
+        tmp_path,
+        f"""
+        import os, signal
+        if not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()   # "checkpoint"
+            os.kill(os.getpid(), signal.SIGKILL)
+        open({str(result)!r}, "w").close()
+        """,
+    )
+    out = drive(cmd, done=result.exists, attempt_timeout_s=60, probe_cmd=None)
+    assert out == DriveOutcome(True, 2, 0, "conclusive")
+
+
+def test_drive_hang_is_killed_then_resume(tmp_path):
+    """A mid-run hang (tunnel wedge) is bounded by the attempt timeout."""
+    marker = tmp_path / "progress"
+    result = tmp_path / "result"
+    cmd = _script(
+        tmp_path,
+        f"""
+        import os, time
+        if not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            time.sleep(3600)
+        open({str(result)!r}, "w").close()
+        """,
+    )
+    out = drive(cmd, done=result.exists, attempt_timeout_s=3, probe_cmd=None)
+    assert out.ok and out.attempts == 2 and out.last_rc == 0
+
+
+def test_drive_restart_budget_exhausted(tmp_path):
+    """A child that always dies fails conclusively, never loops forever."""
+    result = tmp_path / "result"
+    cmd = _script(tmp_path, "raise SystemExit(3)")
+    out = drive(
+        cmd, done=result.exists, attempt_timeout_s=60, max_restarts=2, probe_cmd=None
+    )
+    assert not out.ok and out.attempts == 3 and out.last_rc == 3
+    assert out.note == "restart budget exhausted"
+
+
+def test_drive_zero_exit_without_result_is_a_failed_attempt(tmp_path):
+    """rc 0 is not success — only done() is (a child can die orderly
+    after losing its device but before writing the result)."""
+    result = tmp_path / "result"
+    cmd = _script(tmp_path, "pass")
+    out = drive(
+        cmd, done=result.exists, attempt_timeout_s=60, max_restarts=1, probe_cmd=None
+    )
+    assert not out.ok and out.attempts == 2
+
+
+def test_drive_probe_gates_relaunch(tmp_path):
+    """Between attempts the backend probe must answer before relaunch;
+    a probe that never answers fails the drive with its own note."""
+    result = tmp_path / "result"
+    cmd = _script(tmp_path, "raise SystemExit(1)")
+    out = drive(
+        cmd,
+        done=result.exists,
+        attempt_timeout_s=60,
+        max_restarts=3,
+        probe_cmd=[sys.executable, "-c", "raise SystemExit(1)"],
+        probe_interval_s=0.01,
+        max_probes=2,
+    )
+    assert not out.ok and out.attempts == 1
+    assert out.note == "backend never answered between attempts"
+
+
+def test_adv_bench_resilient_resumes_through_worker_death(tmp_path):
+    """End to end: the device search is SIGKILLed at its first checkpoint
+    (S2VTPU_TEST_CRASH_ON_CHECKPOINT=1), and the resilient parent resumes
+    it from that checkpoint to a conclusive OK in exactly two attempts."""
+    env = dict(os.environ)
+    env["S2VTPU_TEST_CRASH_ON_CHECKPOINT"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    ck = tmp_path / "ck" / "adv"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "adv_bench.py"),
+            "7",
+            "--skip-oracle",
+            "--skip-native",
+            "--resilient",
+            "--no-probe",
+            "--once",
+            "--checkpoint-every",
+            "2",
+            "--checkpoint",
+            str(ck),
+            "--frontier",
+            "65536",
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = [l for l in proc.stdout.splitlines() if l.startswith("resilient k=7")]
+    assert line and "OK" in line[0] and "attempts=2" in line[0], proc.stdout
+    res = json.loads((tmp_path / "ck" / "adv.k7.json").read_text())
+    assert res["outcome"] == "OK" and res["k"] == 7
+    # The conclusive run cleaned its checkpoint up.
+    assert not (tmp_path / "ck" / "adv.k7").exists()
