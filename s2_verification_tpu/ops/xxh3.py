@@ -79,8 +79,36 @@ def fold_record_hashes_masked(stream_hash: U64, record_hashes: U64, mask) -> U64
         return u64.select(m, nxt, acc), None
 
     mask = jnp.asarray(mask, bool)
-    acc, _ = lax.scan(step, stream_hash, (record_hashes.hi, record_hashes.lo, mask))
+    n = int(mask.shape[0])
+    acc, _ = lax.scan(
+        step,
+        stream_hash,
+        (record_hashes.hi, record_hashes.lo, mask),
+        unroll=_fold_unroll(n),
+    )
     return acc
+
+
+def _fold_unroll(length: int) -> int:
+    """Scan unroll factor for the fold loops.  The fold is sequential by
+    construction; on narrow lanes (the forced-stretch fast path runs it on
+    ONE lane) each scan step is a tiny kernel whose fixed issue latency
+    dominates on an accelerator, so unrolling trades program size for an
+    8x shorter sequential chain.  The cpu backend keeps the rolled loop —
+    its scan steps are cheap function calls and the unroll measured ~8%
+    slower there.  Batch widths are padded to powers of two
+    (models/encode.py shape bucketing), so 8 always divides ``length``
+    when ``length >= 8``.  Env override: S2VTPU_FOLD_UNROLL."""
+    import os
+
+    env = os.environ.get("S2VTPU_FOLD_UNROLL")
+    if env:
+        return min(max(1, int(env)), max(1, length))
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return 1
+    return min(8, max(1, length))
 
 
 def fold_record_hashes_indexed(stream_hash: U64, row, length, rh_hi, rh_lo) -> U64:
@@ -99,5 +127,10 @@ def fold_record_hashes_indexed(stream_hash: U64, row, length, rh_hi, rh_lo) -> U
         nxt = chain_hash(acc, U64(rh_hi[row, i], rh_lo[row, i]))
         return u64.select(i < length, nxt, acc), None
 
-    acc, _ = lax.scan(step, stream_hash, jnp.arange(rh_hi.shape[1]))
+    acc, _ = lax.scan(
+        step,
+        stream_hash,
+        jnp.arange(rh_hi.shape[1]),
+        unroll=_fold_unroll(int(rh_hi.shape[1])),
+    )
     return acc

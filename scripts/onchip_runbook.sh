@@ -49,6 +49,10 @@ RES="--resilient --max-restarts 3 --probe-interval 120 --max-probes 20 --skip-or
 log "1. bench.py (headline + adversarial line, isolated child)"
 timeout 3600 python bench.py > "$OUT/bench.out" 2> "$OUT/bench.err"; log "bench rc=$?"
 
+log "1b. headline fold-unroll ablation (default 8 vs rolled)"
+S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=1 timeout 1800 python bench.py > "$OUT/bench_unroll1.out" 2>&1; log "rc=$?"
+S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=16 timeout 1800 python bench.py > "$OUT/bench_unroll16.out" 2>&1; log "rc=$?"
+
 log "2. adv_bench k=10 packed+probe dedup"
 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/probe" > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
 

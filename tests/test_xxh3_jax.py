@@ -112,6 +112,30 @@ def test_fold_empty_mask_is_identity():
     assert int(got) == 77
 
 
+def test_fold_unroll_factors_agree():
+    """The accelerator unroll (ops/xxh3.py _fold_unroll) must be a pure
+    latency trade: every factor computes the identical fold, including
+    lengths the factor does not divide."""
+    import s2_verification_tpu.ops.xxh3 as xxh3_mod
+
+    for n, pad in ((1, 0), (5, 3), (13, 3), (16, 0), (30, 2)):
+        hs = rand64(n)
+        start = rng.getrandbits(64)
+        mask = np.array([True] * n + [False] * pad)
+        padded = u(hs + [0] * pad)
+        want = hashing.fold_record_hashes(start, hs)
+        for factor in (1, 2, 8):
+            orig = xxh3_mod._fold_unroll
+            xxh3_mod._fold_unroll = lambda _n, _f=factor: min(_f, max(1, _n))
+            try:
+                got = ints(
+                    jax.jit(fold_record_hashes_masked)(scalar(start), padded, mask)
+                )
+            finally:
+                xxh3_mod._fold_unroll = orig
+            assert int(got) == want, (n, pad, factor)
+
+
 def test_vmapped_fold():
     # The search folds one batch of hashes from many candidate states.
     starts = rand64(50)
