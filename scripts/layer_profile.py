@@ -18,6 +18,18 @@ layer-full - layer-nofold ~ fold share; layer-nofold is the dedup +
 gather/scatter structural share.  This is the measured basis for picking
 the next kernel optimization (SURVEY.md section 3.5 hot ops), replacing
 the indirect 1-record-batch comparison BASELINE.md used before.
+
+A roofline table follows the apportionment: an analytic per-phase model
+of HBM bytes moved and u32 ALU ops executed (derived from the layer's
+actual shapes — see _roofline for the per-term accounting), the achieved
+GB/s and Gop/s from the measured times, and each phase's fraction of the
+backend's peak.  The phase whose model predicts the larger time at peak
+is its *binding resource*: utilization near that peak means the next
+speedup needs a different algorithm, far below it means tuning.  Peaks
+are env-overridable (S2VTPU_PEAK_GBPS / S2VTPU_PEAK_GOPS); defaults are
+v5e HBM 819 GB/s and a ~6.1 Tu32op/s VPU estimate (1024 lanes x 4 ALUs x
+1.5 GHz derived from the public 197 bf16 TFLOP/s figure) on tpu, and
+deliberately rough placeholders on cpu.
 """
 
 from __future__ import annotations
@@ -75,6 +87,91 @@ def _time(fn, reps: int) -> float:
     for _ in range(reps):
         fn()
     return (time.monotonic() - t0) / reps
+
+
+#: u32 ALU ops per chain_hash scan step (ops/xxh3.py): seed byteswap+xor
+#: (~4), u64 sub for the bitflip (~4), keyed xor (2), rrmxmx = two rotls
+#: (~6 each), two u64 muls (~10 each: 3 cross 32x32 products + carries),
+#: shifted xor/add mixes (~14), plus the mask select (~2).
+_FOLD_OPS_PER_STEP = 62
+
+
+def _roofline(
+    fs: int, c: int, lw: int, exact_pack: bool, sort_dedup: bool
+) -> dict[str, tuple[float, float]]:
+    """Analytic (bytes, u32-ops) per phase for one expansion layer at
+    bucket ``fs`` with ``c`` chains and record-hash table width ``lw``.
+
+    Counts only first-order terms, assuming every gather/scatter lane
+    misses to HBM (no cache credit) — an upper bound on traffic, so
+    achieved/peak fractions are conservative.  All words are u32 (4 B).
+    """
+    e = fs * c
+    e2 = 2 * e
+    # fold: per candidate lane, a lw-step scan; each step gathers one
+    # (hi, lo) record-hash column pair (8 B) and runs chain_hash.
+    fold = (e * lw * 8 + e * 8, e * lw * _FOLD_OPS_PER_STEP)
+    if exact_pack:
+        # key+hash: packed-key [F,C] u64 mul + tree sum, then per-child
+        # key add and two multiplicative hash mixes over the six identity
+        # words.
+        key = (fs * c * 8 + e2 * 24, fs * c * 20 + e2 * 70)
+    else:
+        # Zobrist variant: [F,C] table fold (two gathers per cell) plus
+        # per-child incremental delta gathers, and the dedup compare
+        # becomes a fused gather-compare-reduce over the parent counts
+        # ([e2] x C word reads) instead of two packed words.
+        key = (
+            fs * c * 16 + e2 * 16 + e2 * c * 4,
+            fs * c * 10 + e2 * 40 + e2 * c * 2,
+        )
+    if sort_dedup:
+        # lax.sort on 8 u32 keys: bitonic-style compare-exchange network,
+        # log2(n)*(log2(n)+1)/2 passes each streaming all rows (32 B read
+        # + write per row per pass), plus the unique-head scatter.
+        lg = max(1, (e2 - 1).bit_length())
+        passes = lg * (lg + 1) / 2
+        dedup = (passes * e2 * 64 + e2 * 5, passes * e2 * 16)
+    else:
+        # scatter-min probe table: materialize the six e2 child arrays,
+        # then 3 rounds x (scatter + winner gather + 6-word compare).
+        dedup = (e2 * 24 + 3 * (e2 * 32), e2 * 90)
+    # compact: cumsum + 6 scatters into F rows + counts rebuild ([F,C]
+    # gather + write).
+    compact = (e2 * 20 + fs * c * 8, e2 * 10 + fs * c * 4)
+    return {"fold": fold, "structure": tuple(map(sum, zip(key, dedup, compact)))}
+
+
+def _print_roofline(model: dict, fold_s: float, structure_s: float, backend: str):
+    if backend == "tpu":
+        peak_gbps = float(os.environ.get("S2VTPU_PEAK_GBPS", "819"))
+        peak_gops = float(os.environ.get("S2VTPU_PEAK_GOPS", "6100"))
+        est = "v5e"
+    else:
+        peak_gbps = float(os.environ.get("S2VTPU_PEAK_GBPS", "50"))
+        peak_gops = float(os.environ.get("S2VTPU_PEAK_GOPS", "300"))
+        est = "rough host placeholder"
+    print(
+        f"roofline vs peaks {peak_gbps:.0f} GB/s, {peak_gops / 1e3:.1f} Tu32op/s ({est}):"
+    )
+    print(
+        "  phase        model-GB  model-Gop  meas-s    GB/s   %BWpk   Gop/s  %ALUpk  bound"
+    )
+    for phase, t in (("fold", fold_s), ("structure", structure_s)):
+        b, o = model[phase]
+        gb, go = b / 1e9, o / 1e9
+        t_bw = gb / peak_gbps
+        t_alu = go / peak_gops
+        bound = "HBM-BW" if t_bw >= t_alu else "ALU"
+        if t <= 0:
+            print(f"  {phase:12s} {gb:8.2f} {go:9.2f}   (not separable)")
+            continue
+        print(
+            f"  {phase:12s} {gb:8.2f} {go:9.2f} {t:7.3f} {gb / t:7.1f} "
+            f"{100 * gb / t / peak_gbps:6.1f}% {go / t:7.1f} "
+            f"{100 * go / t / peak_gops:6.1f}%  {bound}",
+            flush=True,
+        )
 
 
 def main() -> int:
@@ -212,6 +309,9 @@ def main() -> int:
         f"structure~{t_nofold * 1e3:.1f} ms ({100 * t_nofold / t_full:.0f}%)",
         flush=True,
     )
+    lw = int(tables.ops.rh_hi.shape[1])
+    model = _roofline(fc, c, lw, xp, sort_dedup)
+    _print_roofline(model, fold, t_nofold, jax.default_backend())
     return 0
 
 
