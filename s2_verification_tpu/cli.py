@@ -263,7 +263,21 @@ def _cmd_collect(args: argparse.Namespace) -> int:
         seed=args.seed,
         faults=faults,
     )
-    path = collect_to_file(cfg, out_dir=args.out_dir)
+    if args.socket:
+        # Loopback-socket transport: the stream state + fault injection
+        # live in a server on another thread/loop, and the collector
+        # speaks the seam protocol over a real async IO boundary — the
+        # stand-in for the reference's network endpoint config
+        # (collect-history.rs:70-94).
+        from .collector.collect import default_stream
+        from .collector.socket_s2 import S2SocketServer, S2SocketTransport
+
+        with S2SocketServer(default_stream(cfg), args.socket):
+            path = collect_to_file(
+                cfg, stream=S2SocketTransport(args.socket), out_dir=args.out_dir
+            )
+    else:
+        path = collect_to_file(cfg, out_dir=args.out_dir)
     # The reference prints the history path as its last act
     # (collect-history.rs:195-200).
     print(path)
@@ -348,6 +362,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="fault-injection intensity for the fake S2 (0 disables)",
     )
     g.add_argument("--out-dir", default="./data")
+    g.add_argument(
+        "--socket",
+        metavar="PATH",
+        help="collect over a loopback unix-domain socket at PATH (serves "
+        "the fault-injecting stream from another thread) instead of the "
+        "in-process call path",
+    )
     g.set_defaults(fn=_cmd_collect)
     return p
 

@@ -32,7 +32,7 @@ from .fake_s2 import FakeS2Stream, FaultPlan
 from .transport import S2StreamTransport
 from .workloads import Ids, HistorySink, WorkloadConfig, run_client
 
-__all__ = ["CollectConfig", "collect_history", "collect_to_file"]
+__all__ = ["CollectConfig", "collect_history", "collect_to_file", "default_stream"]
 
 log = logging.getLogger("s2_verification_tpu.collector")
 
@@ -132,15 +132,23 @@ async def _run(cfg: CollectConfig, stream: S2StreamTransport) -> list[ev.Labeled
     return sink.events
 
 
+def default_stream(cfg: CollectConfig) -> FakeS2Stream:
+    """The canonical fault-injecting stream for a config — ONE derivation
+    of the server-side seed, shared by the in-process path and the
+    loopback-socket server so both transports see identical fault
+    sequences for the same --seed."""
+    return FakeS2Stream(
+        rng=random.Random(cfg.seed ^ 0x5EED),
+        faults=cfg.faults if cfg.faults is not None else FaultPlan.chaos(),
+    )
+
+
 def collect_history(
     cfg: CollectConfig, stream: S2StreamTransport | None = None
 ) -> list[ev.LabeledEvent]:
     """Collect a history in-memory; returns the full event list."""
     if stream is None:
-        stream = FakeS2Stream(
-            rng=random.Random(cfg.seed ^ 0x5EED),
-            faults=cfg.faults if cfg.faults is not None else FaultPlan.chaos(),
-        )
+        stream = default_stream(cfg)
     return asyncio.run(_run(cfg, stream))
 
 
