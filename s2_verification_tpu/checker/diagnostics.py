@@ -25,7 +25,7 @@ import logging
 from ..models.stream import INIT_STATE, step_set
 from .entries import History
 
-__all__ = ["deepest_refusals"]
+__all__ = ["deepest_refusals", "derive_path"]
 
 log = logging.getLogger("s2_verification_tpu.diagnostics")
 
@@ -64,38 +64,52 @@ def _next_cands(history: History, counts) -> tuple[dict[int, int], list[int]]:
     return nxt, cand
 
 
-def deepest_refusals(
+def derive_path(
     history: History,
     deepest: list[int],
     node_budget: int = 200_000,
-) -> tuple[list[int], list[int]] | None:
-    """(deepest prefix ops, ops refusing to linearize there), or None when
-    the prefix cannot be re-derived inside ``node_budget`` DFS nodes."""
+):
+    """Re-derive one concrete linearization ORDER reaching the deepest
+    configuration (a per-chain prefix set), plus its end state.
+
+    Returns ``(order, goal_state)`` — ``order`` is the op-index sequence of
+    a valid path, the per-op ordinals the HTML artifact annotates a failed
+    check with (porcupine's partial-linearization info, main.go:606,627) —
+    or ``(None, None)`` when the set is not a prefix or the DFS exhausts
+    ``node_budget`` nodes."""
     target = _counts_of_deepest(history, deepest)
     if target is None:
         log.warning("deepest set is not a per-chain prefix; no diagnostics")
-        return None
+        return None, None
     tt = tuple(target)
     start = (0,) * len(history.chains)
 
-    seen = {(start, (INIT_STATE.tail, INIT_STATE.stream_hash, INIT_STATE.fencing_token))}
-    stack = [(start, INIT_STATE)]
+    init_key = (
+        start,
+        (INIT_STATE.tail, INIT_STATE.stream_hash, INIT_STATE.fencing_token),
+    )
+    # Parent pointers (key -> (parent key, op index)) reconstruct the path
+    # at the goal without carrying per-node op lists.
+    parent: dict = {init_key: None}
+    stack = [(init_key, INIT_STATE)]
     budget = node_budget
-    goal_state = None
+    goal = None
     while stack:
-        counts_t, state = stack.pop()
+        key, state = stack.pop()
+        counts_t = key[0]
         if counts_t == tt:
-            goal_state = state
+            goal = (key, state)
             break
         nxt, cand = _next_cands(history, counts_t)
         for c in cand:
             if counts_t[c] >= tt[c]:
                 continue
-            op = history.ops[nxt[c]]
+            j = nxt[c]
+            op = history.ops[j]
             nct = counts_t[:c] + (counts_t[c] + 1,) + counts_t[c + 1 :]
             for ns in step_set([state], op.inp, op.out):
-                key = (nct, (ns.tail, ns.stream_hash, ns.fencing_token))
-                if key in seen:
+                nkey = (nct, (ns.tail, ns.stream_hash, ns.fencing_token))
+                if nkey in parent:
                     continue
                 budget -= 1
                 if budget <= 0:
@@ -103,17 +117,37 @@ def deepest_refusals(
                         "refusal diagnostics exhausted the %d-node budget",
                         node_budget,
                     )
-                    return None
-                seen.add(key)
-                stack.append((nct, ns))
-    if goal_state is None:
+                    return None, None
+                parent[nkey] = (key, j)
+                stack.append((nkey, ns))
+    if goal is None:
         log.warning("deepest configuration not re-derivable; no diagnostics")
-        return None
+        return None, None
+    order: list[int] = []
+    key = goal[0]
+    while parent[key] is not None:
+        key, j = parent[key]
+        order.append(j)
+    order.reverse()
+    return order, goal[1]
 
+
+def deepest_refusals(
+    history: History,
+    deepest: list[int],
+    node_budget: int = 200_000,
+) -> tuple[list[int], list[int]] | None:
+    """(deepest prefix ops in one valid linearization order, ops refusing
+    to linearize there), or None when the prefix cannot be re-derived
+    inside ``node_budget`` DFS nodes."""
+    order, goal_state = derive_path(history, deepest, node_budget)
+    if order is None:
+        return None
+    tt = tuple(_counts_of_deepest(history, deepest))
     nxt, cand = _next_cands(history, tt)
     refused = [
         nxt[c]
         for c in cand
         if not step_set([goal_state], history.ops[nxt[c]].inp, history.ops[nxt[c]].out)
     ]
-    return sorted(deepest), sorted(refused)
+    return order, sorted(refused)

@@ -13,6 +13,7 @@ renders offline, matching the reference's single-artifact behavior.
 from __future__ import annotations
 
 import html
+import json
 
 from .checker.entries import History, Op
 from .checker.oracle import CheckOutcome, CheckResult
@@ -70,7 +71,74 @@ document.querySelectorAll('.op').forEach(el => {
   });
   el.addEventListener('mouseleave', () => tip.style.display = 'none');
 });
+const cfgData = document.getElementById('cfg-data');
+if (cfgData) {
+  const cfgs = JSON.parse(cfgData.textContent);
+  const byOpid = {};
+  document.querySelectorAll('.op[data-opid]').forEach(el => {
+    byOpid[el.dataset.opid] = el;
+  });
+  const apply = i => {
+    const cfg = cfgs[i];
+    for (const [opid, el] of Object.entries(byOpid)) {
+      el.classList.remove('linearized', 'refused');
+      const ord = el.querySelector('.ord');
+      if (ord) ord.remove();
+      el.dataset.tip = el.dataset.basetip;
+      if (opid in cfg.ord) {
+        el.classList.add('linearized');
+        const s = document.createElement('span');
+        s.className = 'ord';
+        s.textContent = cfg.ord[opid];
+        el.appendChild(s);
+        el.dataset.tip += '\\nlinearized at position ' + cfg.ord[opid] +
+          ' (configuration ' + (+i + 1) + ')';
+      }
+      if (cfg.refused.includes(+opid)) {
+        el.classList.add('refused');
+        el.dataset.tip += '\\nREFUSED to linearize at this configuration';
+      }
+    }
+    document.querySelectorAll('.client-summary').forEach(el => {
+      el.textContent = cfg.clients[el.dataset.client] || '';
+    });
+  };
+  const sel = document.getElementById('cfg-select');
+  if (sel) sel.addEventListener('change', () => apply(sel.value));
+  apply(0);
+}
 """
+
+
+def _is_valid_order(history: History, seq: list[int]) -> bool:
+    """Whether ``seq`` is a valid linearization order of its own op set:
+    every step legal from the states it reaches, and no op placed after
+    one whose return precedes its call.  O(n · states) — the cheap check
+    that lets an already-ordered refusals prefix skip the DFS re-derive."""
+    from .models.stream import INIT_STATE, step_set
+
+    states = [INIT_STATE]
+    for j in seq:
+        op = history.ops[j]
+        states = step_set(states, op.inp, op.out)
+        if not states:
+            return False
+    # Real-time windows: a violation exists iff at some split point an
+    # earlier op's call exceeds a later op's return (a.ret < b.call with b
+    # before a) — prefix-max(call) vs suffix-min(ret), O(n).
+    n = len(seq)
+    suffix_min_ret = [0] * (n + 1)
+    suffix_min_ret[n] = 1 << 62
+    for i in range(n - 1, -1, -1):
+        op = history.ops[seq[i]]
+        ret = (1 << 62) if op.pending else op.ret
+        suffix_min_ret[i] = min(suffix_min_ret[i + 1], ret)
+    max_call = -1
+    for i in range(n):
+        max_call = max(max_call, history.ops[seq[i]].call)
+        if suffix_min_ret[i + 1] < max_call:
+            return False
+    return True
 
 
 def _op_class(op: Op) -> str:
@@ -107,6 +175,67 @@ def render_html(
         for _, refused in (result.refusals or [])
         for i in refused
     }
+    # Per-configuration exploration data for failed/inconclusive checks:
+    # each deepest configuration gets one concrete linearization ORDER
+    # (re-derived; diagnostics.derive_path), its refusing ops, and a
+    # per-client breakdown — the explorable partial-linearization info
+    # porcupine's artifact exposes per client (main.go:606,627).
+    cfgs: list[dict] = []
+    if result.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN):
+        from .checker.diagnostics import derive_path
+
+        n_checked = len(checked.ops)
+        for prefix, refused in result.refusals or []:
+            # The prefix may already BE a valid order (diagnostics-derived
+            # refusals store one); re-deriving would repeat a 200k-node DFS
+            # per configuration.  Device-produced configs store sorted sets
+            # — those (and only those) go through derive_path.
+            if _is_valid_order(checked, list(prefix)):
+                order = list(prefix)
+            else:
+                order, _state = derive_path(checked, list(prefix))
+            if order is None:
+                # Not re-derivable (budget): an empty ord map would make
+                # the selector STRIP the static outlines without replacing
+                # them — drop this configuration from the explorable view
+                # instead (the static deepest/refused annotations and the
+                # textual report above still cover it).
+                continue
+            ordmap = {
+                checked.ops[i].op_id: pos + 1
+                for pos, i in enumerate(order)
+            }
+            refused_ids = sorted(checked.ops[i].op_id for i in refused)
+            clients: dict[str, str] = {}
+            by_client_n: dict[int, int] = {}
+            for i in prefix:
+                cl = checked.ops[i].client_id
+                by_client_n[cl] = by_client_n.get(cl, 0) + 1
+            by_client_r: dict[int, list[int]] = {}
+            for i in refused:
+                op = checked.ops[i]
+                by_client_r.setdefault(op.client_id, []).append(op.op_id)
+            for cl in sorted(set(by_client_n) | set(by_client_r)):
+                total = sum(
+                    1 for op in checked.ops if op.client_id == cl
+                )
+                txt = f"{by_client_n.get(cl, 0)}/{total} ops linearized"
+                if cl in by_client_r:
+                    ids = ", ".join(str(x) for x in sorted(by_client_r[cl]))
+                    txt += f"; REFUSES op {ids}"
+                clients[str(cl)] = txt
+            cfgs.append(
+                {
+                    "ord": ordmap,
+                    "refused": refused_ids,
+                    "clients": clients,
+                    "label": (
+                        f"{len(prefix)}/{n_checked} ops linearized; "
+                        f"refused: {', '.join(map(str, refused_ids)) or '—'}"
+                    ),
+                }
+            )
+    cfg0_ord = cfgs[0]["ord"] if cfgs else {}
 
     n_events = max((op.ret for op in history.ops if not op.pending), default=1)
     n_events = max(n_events, max((op.call for op in history.ops), default=0) + 1)
@@ -123,27 +252,30 @@ def render_html(
             left = 100.0 * op.call / span
             right_ev = n_events + 1 if op.pending else op.ret + 1
             width = max(100.0 * (right_ev - op.call) / span, 0.45)
-            ordinal = order_by_opid.get(op.op_id)
+            ordinal = order_by_opid.get(op.op_id) or cfg0_ord.get(op.op_id)
             classes = ["op", _op_class(op)]
             if ordinal is not None or op.op_id in deepest_opids:
                 classes.append("linearized")
             if op.op_id in refused_opids:
                 classes.append("refused")
-            tip = (
+            base_tip = (
                 f"op {op.op_id} (client {op.client_id})\n"
                 f"{describe_operation(op.inp, op.out)}\n"
                 f"window: call@{op.call} → "
                 f"{'pending' if op.pending else f'ret@{op.ret}'}"
             )
+            tip = base_tip
             if ordinal is not None:
                 tip += f"\nlinearized at position {ordinal}"
             if op.op_id in refused_opids:
                 tip += "\nREFUSED to linearize at the deepest prefix"
             ord_html = f'<span class="ord">{ordinal}</span>' if ordinal else ""
             tip_attr = html.escape(tip, quote=True).replace("\n", "&#10;")
+            base_attr = html.escape(base_tip, quote=True).replace("\n", "&#10;")
             bars.append(
                 f'<div class="{" ".join(classes)}" '
                 f'style="left:{left:.3f}%;width:{width:.3f}%" '
+                f'data-opid="{op.op_id}" data-basetip="{base_attr}" '
                 f'data-tip="{tip_attr}">{ord_html}</div>'
             )
         lanes.append(
@@ -196,26 +328,46 @@ def render_html(
                 f"op{'s' if len(refused_opids) != 1 else ''} "
                 f"<code>{html.escape(ids)}</code> (red dashed outline)</div>"
             )
-            # Per-configuration detail (the explorable partial-linearization
-            # info porcupine's artifact exposes, main.go:606,627).
-            items = []
-            for prefix, refused in result.refusals:
-                r_ids = ", ".join(
-                    str(checked.ops[i].op_id) for i in sorted(refused)
+        if cfgs:
+            # Explorable per-configuration view: the selector re-annotates
+            # the timeline (ordinals, refused outlines, per-client
+            # breakdown) for the chosen deepest configuration.
+            if len(cfgs) > 1:
+                opts = "".join(
+                    f'<option value="{i}">configuration {i + 1}: '
+                    f"{html.escape(c['label'])}</option>"
+                    for i, c in enumerate(cfgs)
                 )
-                items.append(
-                    f"<li>{len(prefix)} / {len(checked.ops)} ops linearized; "
-                    f"refused: <code>{html.escape(r_ids) or '—'}</code></li>"
+                pieces.append(
+                    f'<div class="final">explore deepest configuration: '
+                    f'<select id="cfg-select">{opts}</select></div>'
                 )
-            pieces.append(
-                f'<div class="final">per configuration:<ul>'
-                f'{"".join(items)}</ul></div>'
+            else:
+                pieces.append(
+                    f'<div class="final">deepest configuration: '
+                    f"{html.escape(cfgs[0]['label'])}</div>"
+                )
+            all_clients = sorted(
+                {int(k) for c in cfgs for k in c["clients"]}
             )
+            rows = "".join(
+                f'<div>client {cl}: <span class="client-summary" '
+                f'data-client="{cl}"></span></div>'
+                for cl in all_clients
+            )
+            pieces.append(f'<div class="final">per client:{rows}</div>')
     body = "\n".join(pieces)
+    cfg_json = ""
+    if cfgs:
+        payload = json.dumps(cfgs).replace("</", "<\\/")
+        cfg_json = (
+            f'<script type="application/json" id="cfg-data">{payload}</script>'
+        )
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
         f"<title>{html.escape(title)}</title><style>{_CSS}</style></head>"
-        f"<body>{body}<div id='tip'></div><script>{_JS}</script></body></html>"
+        f"<body>{body}<div id='tip'></div>{cfg_json}"
+        f"<script>{_JS}</script></body></html>"
     )
 
 

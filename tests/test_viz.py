@@ -1,0 +1,112 @@
+"""The failure artifact's explorable partial-linearization view.
+
+Reference analog: ``porcupine.Visualize`` renders per-op partial
+linearizations a reader can explore per client on a failed check
+(golang/s2-porcupine/main.go:606-631).  The artifact here must carry, for
+each deepest configuration: one concrete linearization order (ordinals),
+the refusing ops, and a per-client breakdown naming the culprit.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.diagnostics import deepest_refusals, derive_path
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.utils.events import LabeledEvent, ReadSuccess
+from s2_verification_tpu.viz import render_html
+
+
+def _tampered_history():
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=15,
+            workflow="regular",
+            seed=3,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.2, max_latency=0.001),
+        )
+    )
+    out, done = [], False
+    for e in events:
+        if not done and isinstance(e.event, ReadSuccess) and e.event.tail > 0:
+            e = LabeledEvent(
+                ReadSuccess(
+                    tail=e.event.tail, stream_hash=e.event.stream_hash ^ 1
+                ),
+                e.client_id,
+                e.op_id,
+            )
+            done = True
+        out.append(e)
+    assert done
+    return prepare(out)
+
+
+def _cfg_payload(html_text: str):
+    m = re.search(
+        r'<script type="application/json" id="cfg-data">(.*?)</script>',
+        html_text,
+        re.S,
+    )
+    assert m, "failure artifact is missing the cfg-data payload"
+    return json.loads(m.group(1).replace("<\\/", "</"))
+
+
+def test_failure_artifact_has_explorable_configurations():
+    hist = _tampered_history()
+    res = check(hist, time_budget_s=120.0)
+    assert res.outcome == CheckOutcome.ILLEGAL
+    # The oracle doesn't fill refusals itself; the CLI re-derives them
+    # (cli.py) — mirror that here.
+    res.refusals = [deepest_refusals(hist, res.deepest or [])]
+    html_text = render_html(hist, res)
+
+    cfgs = _cfg_payload(html_text)
+    assert len(cfgs) == len(res.refusals)
+    cfg0 = cfgs[0]
+    # One concrete order over the deepest prefix: ordinals 1..n, one per
+    # linearized op.
+    n_prefix = len(res.refusals[0][0])
+    assert len(cfg0["ord"]) == n_prefix
+    assert sorted(cfg0["ord"].values()) == list(range(1, n_prefix + 1))
+    # The refusing culprit is named, and attributed to its client.
+    assert cfg0["refused"]
+    assert any("REFUSES op" in txt for txt in cfg0["clients"].values())
+    # The timeline carries the hooks the selector re-annotates through.
+    assert 'data-opid=' in html_text and 'class="client-summary"' in html_text
+
+
+def test_derive_path_orders_a_device_style_prefix_set():
+    """Device configs hand viz a SORTED prefix set; derive_path must
+    recover a valid order for it (or the artifact loses its ordinals)."""
+    hist = _tampered_history()
+    res = check(hist, time_budget_s=120.0)
+    prefix = sorted(res.deepest)
+    order, state = derive_path(hist, prefix)
+    assert order is not None and state is not None
+    assert sorted(order) == prefix
+
+
+def test_ok_artifact_has_no_config_payload():
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=2,
+            num_ops_per_client=10,
+            workflow="regular",
+            seed=4,
+            indefinite_failure_backoff_s=0.0,
+        )
+    )
+    hist = prepare(events)
+    res = check(hist, time_budget_s=60.0)
+    assert res.outcome == CheckOutcome.OK
+    html_text = render_html(hist, res)
+    assert 'id="cfg-data"' not in html_text
+    # OK ordinals stay server-rendered.
+    assert '<span class="ord">' in html_text
