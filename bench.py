@@ -380,11 +380,24 @@ def north_star() -> int:
     warm_s = time.monotonic() - t0
     if res.outcome != CheckOutcome.OK:
         return _zero_line(f"device outcome {res.outcome} (expected OK)")
-    t0 = time.monotonic()
-    res2 = check_device_auto(hist)
-    dev_s = time.monotonic() - t0
-    assert res2.outcome == CheckOutcome.OK
-    print(f"# device: warm {warm_s:.2f}s, steady {dev_s:.2f}s", file=sys.stderr)
+    # Median-of-N steady state: single-shot numbers on this machine vary
+    # (BASELINE.md records ±30% day-to-day on host cores), and a headline
+    # that is a ratio must not rest on one draw.
+    import statistics
+
+    reps = max(1, int(os.environ.get("S2VTPU_BENCH_REPS", "3")))
+    steady: list[float] = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        res2 = check_device_auto(hist)
+        steady.append(time.monotonic() - t0)
+        assert res2.outcome == CheckOutcome.OK
+    dev_s = statistics.median(steady)
+    print(
+        f"# device: warm {warm_s:.2f}s, steady median-of-{reps} {dev_s:.2f}s "
+        f"(min {min(steady):.2f}, max {max(steady):.2f})",
+        file=sys.stderr,
+    )
 
     t0 = time.monotonic()
     ores = check(hist, time_budget_s=oracle_budget)

@@ -81,7 +81,10 @@ def derive_path(
     if target is None:
         log.warning("deepest set is not a per-chain prefix; no diagnostics")
         return None, None
-    tt = tuple(target)
+    return _derive_from_counts(history, tuple(target), node_budget)
+
+
+def _derive_from_counts(history: History, tt: tuple, node_budget: int):
     start = (0,) * len(history.chains)
 
     init_key = (
@@ -140,10 +143,14 @@ def deepest_refusals(
     """(deepest prefix ops in one valid linearization order, ops refusing
     to linearize there), or None when the prefix cannot be re-derived
     inside ``node_budget`` DFS nodes."""
-    order, goal_state = derive_path(history, deepest, node_budget)
+    target = _counts_of_deepest(history, deepest)
+    if target is None:
+        log.warning("deepest set is not a per-chain prefix; no diagnostics")
+        return None
+    tt = tuple(target)
+    order, goal_state = _derive_from_counts(history, tt, node_budget)
     if order is None:
         return None
-    tt = tuple(_counts_of_deepest(history, deepest))
     nxt, cand = _next_cands(history, tt)
     refused = [
         nxt[c]

@@ -322,13 +322,27 @@ def render_html(
         if refused_opids:
             ids = ", ".join(str(i) for i in sorted(refused_opids))
             n_cfg = len(result.refusals)
+            # With the explorable view active, outlines follow the SELECTED
+            # configuration — the union line must not promise outlines the
+            # initial view doesn't draw.
+            outline_note = (
+                " (outlined per selected configuration)"
+                if cfgs
+                else " (red dashed outline)"
+            )
             pieces.append(
                 f'<div class="final">refusing to linearize at '
                 f"{n_cfg} deepest configuration{'s' if n_cfg != 1 else ''}: "
                 f"op{'s' if len(refused_opids) != 1 else ''} "
-                f"<code>{html.escape(ids)}</code> (red dashed outline)</div>"
+                f"<code>{html.escape(ids)}</code>{outline_note}</div>"
             )
         if cfgs:
+            if len(cfgs) < len(result.refusals or []):
+                pieces.append(
+                    f'<div class="final">{len(cfgs)} of '
+                    f"{len(result.refusals)} configurations explorable "
+                    f"(the rest exceeded the path re-derivation budget)</div>"
+                )
             # Explorable per-configuration view: the selector re-annotates
             # the timeline (ordinals, refused outlines, per-client
             # breakdown) for the chosen deepest configuration.

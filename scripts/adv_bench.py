@@ -70,6 +70,14 @@ def main() -> int:
     )
     ap.add_argument("--once", action="store_true", help="skip the steady-state rerun")
     ap.add_argument(
+        "--reps",
+        type=int,
+        default=1,
+        help="steady-state repetitions; the reported steady is the median "
+        "and the spread is printed (single-shot numbers on this hardware "
+        "vary, BASELINE.md)",
+    )
+    ap.add_argument(
         "--profile",
         metavar="DIR",
         help="wrap the steady device run in jax.profiler.trace(DIR)",
@@ -196,15 +204,33 @@ def main() -> int:
                 r = run_device()
                 warm = time.monotonic() - t0
             steady = warm
-            if not args.once:
-                with trace_ctx():
-                    t0 = time.monotonic()
-                    r = run_device()
-                    steady = time.monotonic() - t0
+            steadies = [warm]
+            if args.once:
+                if args.reps > 1:
+                    print(
+                        f"# --reps {args.reps} ignored under --once "
+                        "(no steady-state reruns)",
+                        flush=True,
+                    )
+            else:
+                import statistics
+
+                steadies = []
+                for _ in range(max(1, args.reps)):
+                    with trace_ctx():
+                        t0 = time.monotonic()
+                        r = run_device()
+                        steadies.append(time.monotonic() - t0)
+                steady = statistics.median(steadies)
             st = r.stats
+            spread = (
+                f" reps={len(steadies)} min={min(steadies):.3f} max={max(steadies):.3f}"
+                if len(steadies) > 1
+                else ""
+            )
             print(
-                f"device  k={k}: {r.outcome.name:8s} warm={warm:8.3f}s steady={steady:8.3f}s "
-                f"layers={st.layers} max_live={st.max_frontier} expanded={st.expanded}",
+                f"device  k={k}: {r.outcome.name:8s} warm={warm:8.3f}s steady={steady:8.3f}s"
+                f"{spread} layers={st.layers} max_live={st.max_frontier} expanded={st.expanded}",
                 flush=True,
             )
             witness_valid = None
@@ -249,6 +275,13 @@ def main() -> int:
                         "outcome": r.outcome.name,
                         "warm_s": round(warm, 3),
                         "steady_s": round(steady, 3),
+                        # Under --once no steady rerun happened: the only
+                        # draw is the warm one, and labeling it steady
+                        # would let consumers mix compile-inclusive and
+                        # steady numbers.
+                        "steady_all": None
+                        if args.once
+                        else [round(s, 3) for s in steadies],
                         "layers": st.layers,
                         "max_live": st.max_frontier,
                         "expanded": st.expanded,
@@ -337,6 +370,7 @@ def _resilient(args) -> int:
             "--start-frontier", str(args.start_frontier),
             "--device-rows", str(args.device_rows),
             "--native-budget", str(args.native_budget),
+            "--reps", str(args.reps),
             "--checkpoint", base,
             "--checkpoint-every", str(args.checkpoint_every),
             "--result-json", base,

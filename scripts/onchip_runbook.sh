@@ -54,14 +54,14 @@ S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=1 time
 S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=16 timeout 1800 python bench.py > "$OUT/bench_unroll16.out" 2>&1; log "rc=$?"
 
 log "2. adv_bench k=10 packed+probe dedup"
-timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/probe" > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
+timeout 7200 python scripts/adv_bench.py 10 $RES --reps 3 --attempt-timeout 1800 --checkpoint "$OUT/ck/probe" > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
 
 log "3. adv_bench k=10 sort dedup"
-S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/sort" > "$OUT/k10_sort.out" 2>&1; log "rc=$?"
+S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --reps 3 --attempt-timeout 1800 --checkpoint "$OUT/ck/sort" > "$OUT/k10_sort.out" 2>&1; log "rc=$?"
 
 log "4. adv_bench k=10 pallas fold (and pallas+sort)"
-S2VTPU_PALLAS_FOLD=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/pallas" > "$OUT/k10_pallas.out" 2>&1; log "rc=$?"
-S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --checkpoint "$OUT/ck/psort" > "$OUT/k10_pallas_sort.out" 2>&1; log "rc=$?"
+S2VTPU_PALLAS_FOLD=1 timeout 7200 python scripts/adv_bench.py 10 $RES --reps 3 --attempt-timeout 1800 --checkpoint "$OUT/ck/pallas" > "$OUT/k10_pallas.out" 2>&1; log "rc=$?"
+S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1 timeout 7200 python scripts/adv_bench.py 10 $RES --reps 3 --attempt-timeout 1800 --checkpoint "$OUT/ck/psort" > "$OUT/k10_pallas_sort.out" 2>&1; log "rc=$?"
 
 log "5. layer_profile k=10: probe / sort / pallas"
 timeout 1800 python scripts/layer_profile.py --k 10 --reps 3 > "$OUT/prof_probe.out" 2>&1; log "prof probe rc=$?"
