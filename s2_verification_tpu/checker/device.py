@@ -425,14 +425,17 @@ def _fast_multi(tables: SearchTables, budget, frontier: Frontier):
         chain = jnp.argmax(cand)
         return nxt[chain], chain, cand.sum() == 1
 
+    # The candidate sweep is CARRIED across iterations (computed once per
+    # op, in the step that produced the configuration) instead of being
+    # re-evaluated by both cond and step — the loop is latency-bound on an
+    # accelerator (tiny kernels on 1 lane), so halving the per-op gather
+    # chains matters there and costs nothing elsewhere.
     def cond(st):
-        counts, tail, hi, lo, tok, valid, n = st
-        o, _, single = nxt_op(counts)
+        counts, tail, hi, lo, tok, valid, n, o, chain, single = st
         return valid & single & ~tables.is_indef[o] & (n < budget)
 
     def step(st):
-        counts, tail, hi, lo, tok, valid, n = st
-        o, chain, _ = nxt_op(counts)
+        counts, tail, hi, lo, tok, valid, n, o, chain, _single = st
         sa, va, _sb, _vb = step_kernel(
             tables.ops, o, DeviceState(tail, hi, lo, tok)
         )
@@ -441,26 +444,37 @@ def _fast_multi(tables: SearchTables, budget, frontier: Frontier):
         # exact death-point configuration — the refusal diagnostics replay
         # from it (a stretch-entry snapshot would name no culprit).
         new = lambda good, old: jnp.where(va, good, old)
+        counts2 = new(counts.at[chain].add(1), counts)
+        o2, chain2, single2 = nxt_op(counts2)
         return (
-            new(counts.at[chain].add(1), counts),
+            counts2,
             new(sa.tail, tail),
             new(sa.hash_hi, hi),
             new(sa.hash_lo, lo),
             new(sa.token, tok),
             va,
             n + 1,
+            o2,
+            chain2,
+            single2,
         )
 
+    counts0 = frontier.counts[idx]
+    o0, chain0, single0 = nxt_op(counts0)
     st = (
-        frontier.counts[idx],
+        counts0,
         frontier.tail[idx],
         frontier.hi[idx],
         frontier.lo[idx],
         frontier.tok[idx],
         jnp.ones((), bool),
         jnp.zeros((), _I32),
+        o0,
+        chain0,
+        single0,
     )
-    counts, tail, hi, lo, tok, valid, n = lax.while_loop(cond, step, st)
+    out_st = lax.while_loop(cond, step, st)
+    counts, tail, hi, lo, tok, valid, n = out_st[:7]
     # The idx row stays marked valid even when it died: on STOP_EMPTY the
     # driver's refusal diagnostics need the death-point configuration (the
     # 10th return element routes this frontier to them); n_unique carries
