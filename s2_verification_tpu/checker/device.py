@@ -114,6 +114,10 @@ asarray = np.asarray
 _I32 = jnp.int32
 _U32 = jnp.uint32
 
+#: Opt-in: exact sort dedup for tiny layers (see _expand_layer).  Read at
+#: import so the flag is uniform across every program this process traces.
+_TINY_SORT = os.environ.get("S2VTPU_TINY_SORT") == "1"
+
 #: beam-priority classes (linearized-indefinite-append counts) are clamped
 #: here; ties above the clamp only coarsen pruning priority, never verdicts.
 _OPENS_CAP = 256
@@ -727,7 +731,18 @@ def _expand_layer(
         hh1 = _mix_hash([cz1, t2, h2, l2, k2], e2, 0x811C9DC5)
         hh2 = _mix_hash([cz2, t2, h2, l2, k2], e2, 0x9747B28C)
 
-    if exact_pack and sort_dedup:
+    # S2VTPU_TINY_SORT=1: tiny layers take the sort path when the packed
+    # key exists — one 6-word sort of a few hundred rows is exact,
+    # scatter-free, and fewer kernels than three probe rounds, a latency
+    # trade for the collector regime's tiny buckets on an accelerator.
+    # NOT the default: measured 0.23s -> 0.37s on host cores (XLA:CPU
+    # tuple-sort overhead beats the probe rounds there); the on-chip
+    # runbook ablates it.  (Fewer PROBE rounds at tiny sizes is not an
+    # alternative: at e2=192 the table is 256 slots = 0.75 load factor,
+    # and dropped rounds keep colliding duplicates — measured 1.6x slower
+    # via frontier bloat.)
+    tiny_sort = _TINY_SORT and e2 <= 4096
+    if exact_pack and (sort_dedup or tiny_sort):
         # Sort-based exact dedup: with the packed key the whole child
         # identity is six u32 words, so one lexicographic sort (invalid
         # rows keyed last) puts every duplicate adjacent to its twin —

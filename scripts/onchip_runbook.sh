@@ -53,6 +53,9 @@ log "1b. headline fold-unroll ablation (default 8 vs rolled)"
 S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=1 timeout 1800 python bench.py > "$OUT/bench_unroll1.out" 2>&1; log "rc=$?"
 S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_FOLD_UNROLL=16 timeout 1800 python bench.py > "$OUT/bench_unroll16.out" 2>&1; log "rc=$?"
 
+log "1c. headline tiny-sort ablation"
+S2VTPU_BENCH_SKIP_ADV=1 S2VTPU_BENCH_ORACLE_BUDGET_S=1 S2VTPU_TINY_SORT=1 timeout 1800 python bench.py > "$OUT/bench_tinysort.out" 2>&1; log "rc=$?"
+
 log "2. adv_bench k=10 packed+probe dedup"
 timeout 7200 python scripts/adv_bench.py 10 $RES --reps 3 --attempt-timeout 1800 --checkpoint "$OUT/ck/probe" > "$OUT/k10_probe.out" 2>&1; log "rc=$?"
 

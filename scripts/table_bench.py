@@ -1,6 +1,6 @@
 """Measure the BASELINE.md collector-config table across engines.
 
-Usage: python scripts/table_bench.py [--skip-device] [--seed N]
+Usage: python scripts/table_bench.py [--skip-device] [--seed N] [--reps N]
 
 Runs the five BASELINE.json configs (plus the 5x2000 north-star shape)
 through the Python oracle, the C++ native engine, and the device search
@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import os
+import statistics
 import sys
 import time
 
@@ -37,6 +38,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-device", action="store_true")
     ap.add_argument("--seed", type=int, default=4242)
+    ap.add_argument(
+        "--reps",
+        type=int,
+        default=3,
+        help="device steady-state repetitions (median reported; "
+        "single-shot numbers vary, BASELINE.md)",
+    )
     args = ap.parse_args()
 
     for workflow, clients, ops in CONFIGS:
@@ -62,9 +70,12 @@ def main() -> int:
             t0 = time.monotonic()
             d = check_device_auto(hist)
             w_s = time.monotonic() - t0
-            t0 = time.monotonic()
-            d = check_device_auto(hist)
-            d_s = time.monotonic() - t0
+            steadies = []
+            for _ in range(max(1, args.reps)):
+                t0 = time.monotonic()
+                d = check_device_auto(hist)
+                steadies.append(time.monotonic() - t0)
+            d_s = statistics.median(steadies)
             doutcome = d.outcome.name
             # A budget-limited engine may say UNKNOWN where another is
             # conclusive (the CPU-intractable configs are the point of the

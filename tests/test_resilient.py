@@ -61,7 +61,11 @@ def test_drive_hang_is_killed_then_resume(tmp_path):
         open({str(result)!r}, "w").close()
         """,
     )
-    out = drive(cmd, done=result.exists, attempt_timeout_s=3, probe_cmd=None)
+    # Attempt timeout: big enough that interpreter startup on a loaded
+    # machine can't kill the child BEFORE its marker (which would replay
+    # the hang forever), small enough not to dominate suite wall time —
+    # the first attempt always sleeps until this timeout kills it.
+    out = drive(cmd, done=result.exists, attempt_timeout_s=10, probe_cmd=None)
     assert out.ok and out.attempts == 2 and out.last_rc == 0
 
 

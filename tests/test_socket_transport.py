@@ -91,6 +91,18 @@ def test_snapshot_bodies_blocking_path(served):
     assert client.snapshot_bodies() == [b"pre1", b"pre2"]
 
 
+def test_stale_socket_path_surfaces_bind_error(tmp_path):
+    """A stale socket file (crashed previous run) must fail startup with
+    the real bind error as the cause, not a silent dead server thread."""
+    path = tmp_path / "s2.sock"
+    path.touch()
+    fake = FakeS2Stream(rng=random.Random(1))
+    with pytest.raises(RuntimeError) as exc_info:
+        with S2SocketServer(fake, str(path)):
+            pass
+    assert exc_info.value.__cause__ is not None
+
+
 def test_collect_history_over_socket_linearizable(tmp_path):
     """End to end: the full collector pipeline over the socket, with
     faults on, yields a history the oracle finds linearizable."""
