@@ -93,6 +93,7 @@ def _run_backend(
     time_budget_s: float | None,
     checkpoint: str | None = None,
     device_rows: int | None = None,
+    collect_stats: bool = False,
 ) -> CheckResult:
     # Budget 0 = run to completion, the reference's unbounded default
     # (CheckEventsVerbose timeout 0, main.go:606).
@@ -123,8 +124,10 @@ def _run_backend(
     if backend == "frontier":
         from .checker.frontier import check_frontier_auto
 
-        return check_frontier_auto(hist)
+        return check_frontier_auto(hist, collect_stats=collect_stats)
     dev_kw = {} if device_rows is None else {"device_rows_cap": device_rows}
+    if collect_stats:
+        dev_kw["collect_stats"] = True
     if backend == "device":
         pin_platform()
         from .checker.device import check_device_auto
@@ -180,6 +183,7 @@ def _cmd_check(args: argparse.Namespace) -> int:
             args.time_budget,
             checkpoint=args.checkpoint,
             device_rows=args.device_rows,
+            collect_stats=args.stats,
         )
     except Exception as e:  # backend/environment failure, not a verdict
         from .checker.checkpoint import CheckpointError
@@ -236,6 +240,32 @@ def _cmd_check(args: argparse.Namespace) -> int:
             checked=checked,
         )
         log.info("wrote visualization to %s", path)
+
+    if args.stats:
+        # One machine-readable line on stdout — the per-check analog of
+        # bench.py's metric contract (verdict, wall, search statistics,
+        # witness presence), for scripting over many histories.
+        import json as _json
+
+        line = {
+            "outcome": res.outcome.value,
+            "backend": args.backend,
+            "wall_s": round(dt, 4),
+            "ops": len(checked.ops),
+            "witness": res.linearization is not None,
+        }
+        st = getattr(res, "stats", None)
+        if st is not None:
+            line.update(
+                layers=st.layers,
+                max_frontier=st.max_frontier,
+                expanded=st.expanded,
+                auto_closed=st.auto_closed,
+                pruned=st.pruned,
+            )
+        if res.steps:
+            line["steps"] = res.steps
+        print(_json.dumps(line), flush=True)
 
     if res.outcome == CheckOutcome.OK:
         log.info(
@@ -331,6 +361,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
+    )
+    c.add_argument(
+        "-stats",
+        "--stats",
+        action="store_true",
+        help="print one machine-readable JSON line (verdict, wall-clock, "
+        "search statistics) on stdout",
     )
     c.set_defaults(fn=_cmd_check)
 

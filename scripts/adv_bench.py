@@ -377,6 +377,8 @@ def _resilient(args) -> int:
         ]
         if args.applied is not None:
             cmd += ["--applied", str(args.applied)]
+        if args.profile:
+            cmd += ["--profile", args.profile]
         for flag, on in (
             ("--unsat", args.unsat),
             ("--beam", args.beam),

@@ -82,4 +82,7 @@ timeout 14400 python scripts/adv_bench.py 9,10 --unsat $RES --attempt-timeout 36
 
 log "9. table_bench (collector-history table)"
 timeout 3600 python scripts/table_bench.py > "$OUT/table.out" 2>&1; log "rc=$?"
+
+log "10. profiled k=10 run (XLA trace for next-round tuning, resilient)"
+timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --once --profile "$OUT/trace_k10" --checkpoint "$OUT/ck/prof" > "$OUT/k10_profiled.out" 2>&1; log "rc=$?"
 log "SEQUENCE COMPLETE"

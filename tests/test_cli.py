@@ -125,6 +125,26 @@ def test_check_corrupt_exit1(history_path, tmp_path):
     assert "refusing to linearize" in html_text
 
 
+def test_check_stats_line(history_path, capsys):
+    """-stats prints one machine-readable JSON line on stdout (verdict,
+    wall, search statistics) — the per-check analog of bench.py's metric
+    contract."""
+    rc = main(
+        ["check", f"-file={history_path}", "-backend=oracle", "-no-viz", "-stats"]
+    )
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["outcome"] == "ok" and line["backend"] == "oracle"
+    assert line["ops"] > 0 and line["witness"] is True and line["steps"] > 0
+
+    rc = main(
+        ["check", f"-file={history_path}", "-backend=device", "-no-viz", "-stats"]
+    )
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["outcome"] == "ok" and "layers" in line and "max_frontier" in line
+
+
 def test_check_malformed_exit64(tmp_path):
     bad = tmp_path / "bad.jsonl"
     bad.write_text("garbage {\n")
