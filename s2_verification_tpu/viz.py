@@ -185,6 +185,10 @@ def render_html(
         from .checker.diagnostics import derive_path
 
         n_checked = len(checked.ops)
+        # Per-client op totals are configuration-independent: build once.
+        totals: dict[int, int] = {}
+        for op in checked.ops:
+            totals[op.client_id] = totals.get(op.client_id, 0) + 1
         for prefix, refused in result.refusals or []:
             # The prefix may already BE a valid order (diagnostics-derived
             # refusals store one); re-deriving would repeat a 200k-node DFS
@@ -215,11 +219,10 @@ def render_html(
             for i in refused:
                 op = checked.ops[i]
                 by_client_r.setdefault(op.client_id, []).append(op.op_id)
-            for cl in sorted(set(by_client_n) | set(by_client_r)):
-                total = sum(
-                    1 for op in checked.ops if op.client_id == cl
-                )
-                txt = f"{by_client_n.get(cl, 0)}/{total} ops linearized"
+            # EVERY client appears — "0/n ops linearized" is information
+            # (that client's whole lane is stuck behind the refusal).
+            for cl in sorted(totals):
+                txt = f"{by_client_n.get(cl, 0)}/{totals[cl]} ops linearized"
                 if cl in by_client_r:
                     ids = ", ".join(str(x) for x in sorted(by_client_r[cl]))
                     txt += f"; REFUSES op {ids}"
