@@ -163,9 +163,65 @@ def _run_backend(
     raise ValueError(f"unknown backend {backend!r}")
 
 
+def _resolve_corpus(file_arg: str) -> list[str] | None:
+    """Corpus mode: a directory or glob pattern as ``-file`` expands to a
+    sorted list of histories checked in ONE process — the shape-bucketed
+    encoding amortizes every compile across the corpus (the engine checks
+    thousands of histories in minutes this way; one process per file
+    would pay backend + compile-cache startup each).  Returns None for
+    the single-file case (including stdin)."""
+    if file_arg == "-":
+        return None
+    import glob as _glob
+
+    if os.path.isdir(file_arg):
+        pattern = os.path.join(file_arg, "*.jsonl")
+    elif any(ch in file_arg for ch in "*?[") and not os.path.isfile(file_arg):
+        # A literal filename that merely CONTAINS glob characters (e.g.
+        # records[2026].jsonl) stays a single-file check.
+        pattern = file_arg
+    else:
+        return None
+    # Glob matches can include directories (x.jsonl dirs, `data/*`).
+    return sorted(p for p in _glob.glob(pattern) if os.path.isfile(p))
+
+
 def _cmd_check(args: argparse.Namespace) -> int:
+    corpus = _resolve_corpus(args.file)
+    if corpus is not None:
+        if not corpus:
+            log.error("no histories match %s", args.file)
+            return USAGE_EXIT
+        if args.checkpoint:
+            # One snapshot path cannot serve many histories (the
+            # fingerprint binds it to one); refusing beats a clash error
+            # halfway through the corpus.
+            log.warning("-checkpoint is ignored in corpus mode")
+            args.checkpoint = None
+        seen: set[int] = set()
+        for path in corpus:
+            # One unreadable/malformed file must not abort the corpus and
+            # discard verdicts already found — record it and keep going.
+            rc = _check_one(args, path)
+            seen.add(rc)
+            print(
+                f"{path}: "
+                + {0: "OK", 1: "ILLEGAL", 2: "UNKNOWN", 64: "ERROR"}.get(
+                    rc, str(rc)
+                ),
+                flush=True,
+            )
+        # Worst verdict wins: ILLEGAL > unreadable file > UNKNOWN > OK.
+        for code in (1, USAGE_EXIT, 2):
+            if code in seen:
+                return code
+        return 0
+    return _check_one(args, args.file)
+
+
+def _check_one(args: argparse.Namespace, file_path: str) -> int:
     try:
-        events = _read_events(args.file)
+        events = _read_events(file_path)
     except (OSError, ValueError) as e:
         log.error("failed to read history: %s", e)
         return 64
@@ -224,7 +280,7 @@ def _cmd_check(args: argparse.Namespace) -> int:
 
         full = prepare(events, elide_trivial=False)
         os.makedirs(args.out_dir, exist_ok=True)
-        base = "stdin" if args.file == "-" else os.path.basename(args.file)
+        base = "stdin" if file_path == "-" else os.path.basename(file_path)
         fd, path = tempfile.mkstemp(
             prefix=f"{base}-", suffix=".html", dir=args.out_dir
         )
@@ -248,6 +304,7 @@ def _cmd_check(args: argparse.Namespace) -> int:
         import json as _json
 
         line = {
+            "file": file_path,
             "outcome": res.outcome.value,
             "backend": args.backend,
             "wall_s": round(dt, 4),
@@ -326,7 +383,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     c = sub.add_parser("check", help="check a JSONL history for linearizability")
     c.add_argument(
-        "-file", "--file", required=True, help="history JSONL path, '-' for stdin"
+        "-file",
+        "--file",
+        required=True,
+        help="history JSONL path, '-' for stdin; a directory or (quoted) "
+        "glob checks the whole corpus in one process (compiles amortize "
+        "via shape bucketing) — exit code is the worst outcome (ILLEGAL "
+        "> unreadable file > UNKNOWN > OK)",
     )
     c.add_argument(
         "-backend",

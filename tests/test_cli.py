@@ -88,7 +88,9 @@ def test_check_frontier_backend(history_path, tmp_path):
     assert rc == 0
 
 
-def test_check_corrupt_exit1(history_path, tmp_path):
+@pytest.fixture(scope="module")
+def corrupt_history_path(history_path, tmp_path_factory):
+    """history_path with one successful read's stream hash flipped."""
     lines = open(history_path).read().splitlines()
     out = []
     flipped = False
@@ -105,8 +107,13 @@ def test_check_corrupt_exit1(history_path, tmp_path):
             flipped = True
         out.append(json.dumps(o))
     assert flipped, "history has no successful non-empty read to corrupt"
-    bad = tmp_path / "corrupt.jsonl"
+    bad = tmp_path_factory.mktemp("corrupt") / "corrupt.jsonl"
     bad.write_text("\n".join(out) + "\n")
+    return str(bad)
+
+
+def test_check_corrupt_exit1(corrupt_history_path, tmp_path):
+    bad = corrupt_history_path
     rc = main(
         ["check", "-file", str(bad), "-backend", "oracle", "-out-dir", str(tmp_path / "v")]
     )
@@ -143,6 +150,55 @@ def test_check_stats_line(history_path, capsys):
     assert rc == 0
     line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert line["outcome"] == "ok" and "layers" in line and "max_frontier" in line
+
+
+def test_check_corpus_mode(history_path, corrupt_history_path, tmp_path, capsys):
+    """A directory (or glob) as -file checks every history in one process
+    — per-file verdict lines on stdout, worst verdict as the exit code
+    (ILLEGAL > UNKNOWN > OK).  No reference analog: s2-porcupine is one
+    file per invocation (main.go); corpus mode exists because the
+    shape-bucketed engine amortizes compiles across histories."""
+    import shutil
+
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    shutil.copy(history_path, corpus / "good.jsonl")
+    shutil.copy(corrupt_history_path, corpus / "bad.jsonl")
+    # A malformed file mid-corpus must not abort the run or mask the
+    # ILLEGAL verdict found elsewhere.
+    (corpus / "mangled.jsonl").write_text("not json\n")
+    rc = main(
+        [
+            "check",
+            f"-file={corpus}",
+            "-backend=oracle",
+            "-no-viz",
+            "-stats",
+            "--out-dir",
+            str(tmp_path / "viz"),
+        ]
+    )
+    assert rc == 1  # ILLEGAL dominates the unreadable file
+    out = capsys.readouterr().out.splitlines()
+    verdicts = {
+        l.split(": ")[0].split("/")[-1]: l.split(": ")[1]
+        for l in out
+        if l.endswith(("OK", "ILLEGAL", "UNKNOWN", "ERROR"))
+    }
+    assert verdicts == {
+        "good.jsonl": "OK",
+        "bad.jsonl": "ILLEGAL",
+        "mangled.jsonl": "ERROR",
+    }
+    stats = [json.loads(l) for l in out if l.startswith("{")]
+    assert {s["outcome"] for s in stats} == {"ok", "illegal"}
+    assert all("file" in s for s in stats)
+
+
+def test_check_corpus_empty_glob_is_usage_error(tmp_path):
+    empty = tmp_path / "nothing"
+    empty.mkdir()
+    assert main(["check", f"-file={empty}", "-no-viz"]) == 64
 
 
 def test_check_malformed_exit64(tmp_path):
