@@ -26,15 +26,21 @@ mkdir -p "$OUT" "$OUT/ck" "$MIRROR"
 sync_mirror() {
   cp "$OUT"/runbook.log "$OUT"/probe.last "$MIRROR"/ 2>/dev/null
   cp "$OUT"/*.out "$OUT"/*.err "$MIRROR"/ 2>/dev/null
+  cp -r "$OUT"/trace_* "$MIRROR"/ 2>/dev/null
   true
 }
 # Step boundaries sync via log(); the background loop covers a mid-step
 # death (k=12 can run hours — the auto-commit must not miss exactly the
-# measurement the mirror exists to preserve), and the EXIT trap the
-# final state.
+# measurement the mirror exists to preserve), and the traps the final
+# state.  Fatal signals skip bash's EXIT trap: sync + stop the loop
+# first, then re-raise so the exit status stays honest.
 ( while sleep 120; do sync_mirror; done ) &
 SYNC_PID=$!
-trap 'kill "$SYNC_PID" 2>/dev/null; sync_mirror' EXIT
+cleanup() { kill "$SYNC_PID" 2>/dev/null; sync_mirror; }
+trap cleanup EXIT
+for sig in TERM INT HUP; do
+  trap "cleanup; trap - $sig; kill -$sig \$\$" "$sig"
+done
 log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/runbook.log"; sync_mirror; }
 
 if [ "${SKIP_WAIT:-0}" != "1" ]; then

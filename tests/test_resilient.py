@@ -109,6 +109,38 @@ def test_drive_probe_gates_relaunch(tmp_path):
     assert out.note == "backend never answered between attempts"
 
 
+def test_default_probe_cmd_gates_and_passes_on_pinned_cpu(tmp_path, monkeypatch):
+    """The REAL probe command (the one the on-chip driver uses between
+    attempts) must succeed under an explicit JAX_PLATFORMS=cpu pin — the
+    config-API re-pin inside it is what defeats the axon sitecustomize
+    override — so a relaunch is gated on a live backend, not a fake."""
+    from s2_verification_tpu.checker.resilient import default_probe_cmd
+
+    marker = tmp_path / "progress"
+    result = tmp_path / "result"
+    cmd = _script(
+        tmp_path,
+        f"""
+        import os, signal
+        if not os.path.exists({str(marker)!r}):
+            open({str(marker)!r}, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)
+        open({str(result)!r}, "w").close()
+        """,
+    )
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    out = drive(
+        cmd,
+        done=result.exists,
+        attempt_timeout_s=60,
+        probe_cmd=default_probe_cmd(),
+        probe_timeout_s=120,
+        probe_interval_s=0.01,
+        max_probes=2,
+    )
+    assert out == DriveOutcome(True, 2, 0, "conclusive")
+
+
 def test_adv_bench_resilient_resumes_through_worker_death(tmp_path):
     """End to end: the device search is SIGKILLed at its first checkpoint
     (S2VTPU_TEST_CRASH_ON_CHECKPOINT=1), and the resilient parent resumes
