@@ -1,0 +1,124 @@
+"""Summarize the on-chip runbook's variant matrix and name the winner.
+
+Usage: python scripts/pick_variant.py [DIR]   (default /tmp/onchip_r4)
+
+Reads the per-step artifacts the runbook leaves behind — the k=10
+dedup/fold variant results (resilient driver JSONs + stdout), the
+headline ablations (fold unroll, tiny sort), and the k=11/k=12/unsat
+outcomes — and prints a decision table: steady medians with spreads,
+each variant's delta vs the probe-dedup baseline, and which env-var
+combination should become the TPU default (`check_device` reads
+S2VTPU_SORT_DEDUP / S2VTPU_PALLAS_FOLD / S2VTPU_TINY_SORT /
+S2VTPU_FOLD_UNROLL).  Pure stdlib — runs anywhere, no jax import.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+VARIANTS = [
+    ("probe", "probe", "(baseline: packed key + scatter-min probe)"),
+    ("sort", "sort", "S2VTPU_SORT_DEDUP=1"),
+    ("pallas", "pallas", "S2VTPU_PALLAS_FOLD=1"),
+    ("psort", "psort", "S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1"),
+]
+
+
+def _k10_result(out: str, name: str) -> dict | None:
+    path = os.path.join(out, "ck", f"{name}.k10.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _bench_headline(path: str) -> tuple[float, str] | None:
+    """(ops/s, backend) from a bench stdout file, if present."""
+    if not os.path.exists(path):
+        return None
+    for line in open(path, errors="replace"):
+        if '"metric"' in line and "ops_verified_per_sec_chip" in line:
+            try:
+                d = json.loads(line)
+                return float(d["value"]), str(d.get("backend", "?"))
+            except ValueError:
+                pass
+    return None
+
+
+def _grep_outcome(path: str, pat: str) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    return [l.rstrip() for l in open(path, errors="replace") if re.search(pat, l)]
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/onchip_r4"
+    if not os.path.isdir(out):
+        print(f"no results dir at {out}")
+        return 1
+
+    print(f"# variant matrix from {out}\n")
+    print("## k=10 dedup/fold variants (steady median, lower is better)")
+    rows = []
+    for name, _key, env in VARIANTS:
+        r = _k10_result(out, name)
+        if r is None:
+            rows.append((name, env, None, None, None))
+            continue
+        rows.append((name, env, r.get("steady_s"), r.get("steady_all"), r.get("outcome")))
+    base = next((s for n, _e, s, _a, _o in rows if n == "probe" and s), None)
+    for name, env, steady, all_s, outcome in rows:
+        if steady is None:
+            print(f"  {name:8s} (pending)   {env}")
+            continue
+        spread = (
+            f" [{min(all_s):.1f}..{max(all_s):.1f}]" if all_s and len(all_s) > 1 else ""
+        )
+        delta = f"  {steady / base:5.2f}x vs probe" if base else ""
+        print(f"  {name:8s} {steady:8.2f}s{spread} {outcome:8s}{delta}  {env}")
+    done = [(n, s) for n, _e, s, _a, o in rows if s is not None and o == "OK"]
+    if done:
+        winner = min(done, key=lambda t: t[1])
+        host_band = "29-35s host-cores band (BASELINE.md r4)"
+        print(f"\n  WINNER: {winner[0]} at {winner[1]:.2f}s — target: beat the {host_band}")
+        if winner[0] != "probe":
+            env = {n: e for n, _k, e in VARIANTS}[winner[0]]
+            print(f"  -> make TPU default: {env}")
+
+    print("\n## headline ablations (5x2000 collector, ops/s, higher is better)")
+    for label, fname in [
+        ("default (unroll 8)", "bench.out"),
+        ("unroll 1", "bench_unroll1.out"),
+        ("unroll 16", "bench_unroll16.out"),
+        ("tiny-sort", "bench_tinysort.out"),
+    ]:
+        h = _bench_headline(os.path.join(out, fname))
+        if h is None:
+            print(f"  {label:20s} (pending)")
+        else:
+            print(f"  {label:20s} {h[0]:10.1f} ops/s  backend={h[1]}")
+
+    print("\n## big-k and exhaustion side")
+    for fname, pat in [
+        ("k11.out", r"resilient k=11"),
+        ("k12.out", r"resilient k=12|witness k=12"),
+        ("unsat.out", r"resilient k=(9|10)"),
+    ]:
+        lines = _grep_outcome(os.path.join(out, fname), pat)
+        if not lines:
+            print(f"  {fname:12s} (pending)")
+        for l in lines:
+            print(f"  {fname:12s} {l.strip()}")
+
+    traces = glob.glob(os.path.join(out, "trace_k10", "**", "*.pb"), recursive=True)
+    print(f"\n## profiler trace: {'captured' if traces else '(pending)'}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
