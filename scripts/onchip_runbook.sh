@@ -27,6 +27,8 @@ sync_mirror() {
   cp "$OUT"/runbook.log "$OUT"/probe.last "$MIRROR"/ 2>/dev/null
   cp "$OUT"/*.out "$OUT"/*.err "$MIRROR"/ 2>/dev/null
   cp -r "$OUT"/trace_* "$MIRROR"/ 2>/dev/null
+  # The per-variant result JSONs are pick_variant.py's decision inputs.
+  mkdir -p "$MIRROR/ck" && cp "$OUT"/ck/*.json "$MIRROR"/ck/ 2>/dev/null
   true
 }
 # Step boundaries sync via log(); the background loop covers a mid-step

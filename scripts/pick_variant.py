@@ -21,10 +21,10 @@ import re
 import sys
 
 VARIANTS = [
-    ("probe", "probe", "(baseline: packed key + scatter-min probe)"),
-    ("sort", "sort", "S2VTPU_SORT_DEDUP=1"),
-    ("pallas", "pallas", "S2VTPU_PALLAS_FOLD=1"),
-    ("psort", "psort", "S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1"),
+    ("probe", "(baseline: packed key + scatter-min probe)"),
+    ("sort", "S2VTPU_SORT_DEDUP=1"),
+    ("pallas", "S2VTPU_PALLAS_FOLD=1"),
+    ("psort", "S2VTPU_PALLAS_FOLD=1 S2VTPU_SORT_DEDUP=1"),
 ]
 
 
@@ -65,7 +65,7 @@ def main() -> int:
     print(f"# variant matrix from {out}\n")
     print("## k=10 dedup/fold variants (steady median, lower is better)")
     rows = []
-    for name, _key, env in VARIANTS:
+    for name, env in VARIANTS:
         r = _k10_result(out, name)
         if r is None:
             rows.append((name, env, None, None, None))
@@ -74,7 +74,16 @@ def main() -> int:
     base = next((s for n, _e, s, _a, _o in rows if n == "probe" and s), None)
     for name, env, steady, all_s, outcome in rows:
         if steady is None:
-            print(f"  {name:8s} (pending)   {env}")
+            # No result JSON: distinguish a conclusive driver failure
+            # (resilient budget exhausted — re-queueing won't help)
+            # from a step that simply hasn't run yet.
+            failed = _grep_outcome(
+                os.path.join(out, f"k10_{name}.out"), r"resilient k=10: FAILED"
+            )
+            state = "FAILED  " if failed else "(pending)"
+            print(f"  {name:8s} {state}   {env}")
+            if failed:
+                print(f"           {failed[-1].strip()}")
             continue
         spread = (
             f" [{min(all_s):.1f}..{max(all_s):.1f}]" if all_s and len(all_s) > 1 else ""
@@ -87,7 +96,7 @@ def main() -> int:
         host_band = "29-35s host-cores band (BASELINE.md r4)"
         print(f"\n  WINNER: {winner[0]} at {winner[1]:.2f}s — target: beat the {host_band}")
         if winner[0] != "probe":
-            env = {n: e for n, _k, e in VARIANTS}[winner[0]]
+            env = dict(VARIANTS)[winner[0]]
             print(f"  -> make TPU default: {env}")
 
     print("\n## headline ablations (5x2000 collector, ops/s, higher is better)")
