@@ -1,0 +1,87 @@
+"""The on-chip variant decision table (scripts/pick_variant.py).
+
+The script is how a human (or the next round) reads the runbook's
+surviving artifacts; its three states per variant — result, conclusive
+FAILED, pending — must not be confusable, and the winner logic must
+name the env combination that becomes the TPU default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "pick_variant.py")
+
+
+def _run(out_dir) -> str:
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(out_dir)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def _write_result(out_dir, name: str, steady: float, all_s=None) -> None:
+    (out_dir / "ck").mkdir(exist_ok=True)
+    (out_dir / "ck" / f"{name}.k10.json").write_text(
+        json.dumps(
+            {
+                "k": 10,
+                "outcome": "OK",
+                "steady_s": steady,
+                "steady_all": all_s or [steady],
+                "layers": 7,
+            }
+        )
+    )
+
+
+def test_empty_dir_reports_all_pending(tmp_path):
+    text = _run(tmp_path)
+    assert text.count("(pending)") >= 4
+    assert "WINNER" not in text
+
+
+def test_winner_and_default_recommendation(tmp_path):
+    _write_result(tmp_path, "probe", 40.0, [39.0, 40.0, 44.0])
+    _write_result(tmp_path, "sort", 20.0, [19.5, 20.0, 21.0])
+    text = _run(tmp_path)
+    assert "WINNER: sort at 20.00s" in text
+    assert "S2VTPU_SORT_DEDUP=1" in text
+    assert "0.50x vs probe" in text
+
+
+def test_probe_winner_recommends_no_env_change(tmp_path):
+    _write_result(tmp_path, "probe", 20.0)
+    _write_result(tmp_path, "sort", 40.0)
+    text = _run(tmp_path)
+    assert "WINNER: probe" in text
+    assert "make TPU default" not in text
+
+
+def test_conclusive_failure_is_not_pending(tmp_path):
+    _write_result(tmp_path, "probe", 30.0)
+    (tmp_path / "k10_sort.out").write_text(
+        "resilient k=10: FAILED (restart budget exhausted) "
+        "total_wall=7200.000s attempts=4 last_rc=1\n"
+    )
+    text = _run(tmp_path)
+    assert "sort     FAILED" in text
+    assert "restart budget exhausted" in text
+
+
+def test_headline_ablation_lines(tmp_path):
+    (tmp_path / "bench.out").write_text(
+        '{"metric": "ops_verified_per_sec_chip", "value": 21000.5, '
+        '"unit": "ops/s", "vs_baseline": 2.1, "backend": "tpu"}\n'
+    )
+    text = _run(tmp_path)
+    assert "21000.5 ops/s  backend=tpu" in text
+    assert "unroll 1             (pending)" in text
