@@ -48,6 +48,32 @@ def test_bench_clean_run_contract():
     assert line["unit"] == "ops/s"
 
 
+def test_bench_adversarial_line_carries_backend_provenance():
+    """The stderr adversarial metric line must carry the same
+    machine-readable backend marker as the headline — a host-cores
+    number must never pass as an on-chip measurement (the r3 artifact
+    did exactly that for this line)."""
+    proc, line = _run_bench(
+        {
+            "S2VTPU_BENCH_SKIP_ADV": "0",
+            "S2VTPU_BENCH_ADV_K": "6",
+            "S2VTPU_BENCH_ADV_BATCH": "20",
+            "S2VTPU_BENCH_ADV_NATIVE_BUDGET_S": "0",
+        },
+        timeout=600.0,
+    )
+    assert proc.returncode == 0
+    adv = [
+        json.loads(l)
+        for l in proc.stderr.decode().splitlines()
+        if '"metric"' in l and "adversarial" in l
+    ]
+    assert len(adv) == 1, proc.stderr[-2000:]
+    assert adv[0]["metric"] == "adversarial_k6_device_wall_s"
+    assert adv[0]["value"] > 0
+    assert adv[0]["backend"] == "cpu"
+
+
 def test_bench_midrun_hang_degrades_with_contract_line():
     # A 1-second measurement budget guarantees the child is killed mid-run;
     # NO_FALLBACK turns the degradation into the explicit zero line.
