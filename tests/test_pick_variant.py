@@ -77,6 +77,19 @@ def test_conclusive_failure_is_not_pending(tmp_path):
     assert "restart budget exhausted" in text
 
 
+def test_runbook_script_parses(tmp_path):
+    """bash -n over the runbook: the detached measurement matrix is
+    edited often and a syntax slip would silently cost the round's
+    entire on-chip window."""
+    proc = subprocess.run(
+        ["bash", "-n", os.path.join(REPO, "scripts", "onchip_runbook.sh")],
+        capture_output=True,
+        text=True,
+        timeout=30,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
 def test_headline_ablation_lines(tmp_path):
     (tmp_path / "bench.out").write_text(
         '{"metric": "ops_verified_per_sec_chip", "value": 21000.5, '
