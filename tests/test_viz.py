@@ -93,6 +93,39 @@ def test_derive_path_orders_a_device_style_prefix_set():
     assert sorted(order) == prefix
 
 
+def test_script_selectors_match_rendered_markup():
+    """No JS engine exists in this environment to execute the artifact's
+    selector script, so pin the contract statically: every id/class/data
+    attribute the script queries must exist in the rendered failure HTML
+    (and the payload fields it reads must match what render_html emits) —
+    the drift that would actually break the explorable view."""
+    hist = _tampered_history()
+    res = check(hist, time_budget_s=120.0)
+    res.refusals = [deepest_refusals(hist, res.deepest or [])]
+    html_text = render_html(hist, res)
+
+    # Selectors the script queries.
+    for needle in (
+        "getElementById('cfg-data')",
+        ".op[data-opid]",
+        "'.client-summary'",
+        "dataset.basetip",
+        "dataset.client",
+    ):
+        assert needle in html_text, needle
+    # ...and their rendered counterparts.
+    for markup in (
+        'id="cfg-data"',
+        "data-opid=",
+        "data-basetip=",
+        'class="client-summary" data-client=',
+    ):
+        assert markup in html_text, markup
+    # Payload fields the script reads per configuration.
+    cfg0 = _cfg_payload(html_text)[0]
+    assert set(cfg0) >= {"ord", "refused", "clients", "label"}
+
+
 def test_ok_artifact_has_no_config_payload():
     events = collect_history(
         CollectConfig(
