@@ -201,6 +201,31 @@ def test_check_corpus_empty_glob_is_usage_error(tmp_path):
     assert main(["check", f"-file={empty}", "-no-viz"]) == 64
 
 
+def test_corpus_resolution_edge_cases(history_path, tmp_path):
+    """The hazards reviews caught, pinned: a literal filename containing
+    glob metacharacters stays a single-file check; glob matches filter
+    out directories; a directory named *.jsonl is not a corpus entry."""
+    from s2_verification_tpu.cli import _resolve_corpus
+    import shutil
+
+    # Literal [..] in an existing filename: single-file mode.
+    lit = tmp_path / "records[2026].jsonl"
+    shutil.copy(history_path, lit)
+    assert _resolve_corpus(str(lit)) is None
+    assert main(["check", f"-file={lit}", "-backend=oracle", "-no-viz"]) == 0
+
+    # Directory entries that are themselves directories are skipped.
+    d = tmp_path / "corpus"
+    d.mkdir()
+    shutil.copy(history_path, d / "one.jsonl")
+    (d / "adir.jsonl").mkdir()
+    resolved = _resolve_corpus(str(d))
+    assert resolved == [str(d / "one.jsonl")]
+
+    # stdin never resolves to a corpus.
+    assert _resolve_corpus("-") is None
+
+
 def test_check_malformed_exit64(tmp_path):
     bad = tmp_path / "bad.jsonl"
     bad.write_text("garbage {\n")
