@@ -22,8 +22,12 @@ TPU-native (no 64-bit emulation) and composes with vmap/scan/shard_map.
 
 from __future__ import annotations
 
+import logging
+
 import jax.numpy as jnp
 from jax import lax
+
+log = logging.getLogger("s2_verification_tpu.xxh3")
 
 from . import u64
 from .u64 import U64
@@ -38,6 +42,9 @@ __all__ = [
 # le_u64(secret[8..16]) ^ le_u64(secret[16..24]) of the default XXH3 secret.
 _BITFLIP_BASE = 0x1CAD21F72C81017C ^ 0xDB979083E96DD4DE
 _PRIME_MX2 = 0x9FB21C651E98DF25
+
+#: once-flag for the malformed S2VTPU_FOLD_UNROLL warning
+_warned_bad_unroll = False
 
 
 def _rrmxmx(h: U64, length: int = 8) -> U64:
@@ -103,7 +110,16 @@ def _fold_unroll(length: int) -> int:
 
     env = os.environ.get("S2VTPU_FOLD_UNROLL")
     if env:
-        return min(max(1, int(env)), max(1, length))
+        try:
+            return min(max(1, int(env)), max(1, length))
+        except ValueError:
+            # A malformed knob must degrade to the default, not crash the
+            # engine mid-trace — and warn once, not once per retrace
+            # (corpus mode traces thousands of bucket shapes).
+            global _warned_bad_unroll
+            if not _warned_bad_unroll:
+                _warned_bad_unroll = True
+                log.warning("ignoring unparsable S2VTPU_FOLD_UNROLL=%r", env)
     import jax
 
     if jax.default_backend() == "cpu":

@@ -136,6 +136,23 @@ def test_fold_unroll_factors_agree():
             assert int(got) == want, (n, pad, factor)
 
 
+def test_fold_unroll_env_override(monkeypatch):
+    """The env knob clamps to the scan length and a malformed value
+    degrades to the default instead of crashing mid-trace."""
+    from s2_verification_tpu.ops.xxh3 import _fold_unroll
+
+    monkeypatch.setenv("S2VTPU_FOLD_UNROLL", "64")
+    assert _fold_unroll(4) == 4
+    assert _fold_unroll(128) == 64
+    # The suite usually pins cpu (rolled default); S2VTPU_TEST_PLATFORM
+    # can run it on an accelerator, where the default is 8.
+    default = 1 if jax.default_backend() == "cpu" else 8
+    monkeypatch.setenv("S2VTPU_FOLD_UNROLL", "not-a-number")
+    assert _fold_unroll(16) == default
+    monkeypatch.delenv("S2VTPU_FOLD_UNROLL")
+    assert _fold_unroll(16) == default
+
+
 def test_vmapped_fold():
     # The search folds one batch of hashes from many candidate states.
     starts = rand64(50)
