@@ -599,13 +599,21 @@ def mesh_scaling(n: int) -> int:
         f"layers/s 1-shard {l1:.2f} vs {n}-shard {ln:.2f}",
         file=sys.stderr,
     )
+    # The metric line must self-describe: N shards on one host's cores is a
+    # FUNCTIONAL check, not a scaling result, and must not be quotable as
+    # one.  Only a real >=n-device backend earns the scaling name.
     print(
         json.dumps(
             {
-                "metric": f"mesh_{n}x_layer_throughput_ratio",
+                "metric": (
+                    f"mesh_{n}x_virtual_functional_ratio"
+                    if on_cpu
+                    else f"mesh_{n}x_layer_throughput_ratio"
+                ),
                 "value": round(ln / l1, 3),
                 "unit": "x",
                 "vs_baseline": 1.0,
+                "scaling": not on_cpu,
             }
         )
     )

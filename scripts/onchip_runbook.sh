@@ -13,18 +13,24 @@
 # attempts (checker/resilient.py) — a crash costs one segment, not the
 # matrix.
 #
-# Env knobs: OUT (default /tmp/onchip_r4), PROBES (default 200 x ~5.5min),
-# SKIP_WAIT=1 (assume the chip is already up).
+# Probe horizon is INDEFINITE by default (r4 lesson: an outage outlasted
+# the 18 h horizon and the matrix silently never ran).  A HEARTBEAT file
+# in the mirror records probe count + elapsed hours every few probes, so
+# a round-long outage produces a one-glance artifact; if PROBES is ever
+# exhausted a loud GAVE_UP file lands in the mirror.
+#
+# Env knobs: OUT (default /tmp/onchip_r5), PROBES (default 100000 ≈ no
+# horizon), SKIP_WAIT=1 (assume the chip is already up).
 set -u
-OUT="${OUT:-/tmp/onchip_r4}"
+OUT="${OUT:-/tmp/onchip_r5}"
 cd "$(dirname "$0")/.." || exit 1
 # Results mirror INSIDE the repo: the driver auto-commits uncommitted
 # files at round end, so measurements taken after the builder's session
 # ends still reach the judge.
-MIRROR="${MIRROR:-$(pwd)/onchip_r4}"
+MIRROR="${MIRROR:-$(pwd)/onchip_r5}"
 mkdir -p "$OUT" "$OUT/ck" "$MIRROR"
 sync_mirror() {
-  cp "$OUT"/runbook.log "$OUT"/probe.last "$MIRROR"/ 2>/dev/null
+  cp "$OUT"/runbook.log "$OUT"/probe.last "$OUT"/HEARTBEAT "$OUT"/GAVE_UP "$MIRROR"/ 2>/dev/null
   cp "$OUT"/*.out "$OUT"/*.err "$MIRROR"/ 2>/dev/null
   cp -r "$OUT"/trace_* "$MIRROR"/ 2>/dev/null
   # The per-variant result JSONs are pick_variant.py's decision inputs.
@@ -45,10 +51,11 @@ for sig in TERM INT HUP; do
 done
 log() { echo "[$(date -u +%H:%M:%S)] $*" >> "$OUT/runbook.log"; sync_mirror; }
 
+START_EPOCH=$(date +%s)
 if [ "${SKIP_WAIT:-0}" != "1" ]; then
-  log "waiting for TPU..."
+  log "waiting for TPU (indefinite probe loop, heartbeat in HEARTBEAT)..."
   ok=0
-  n="${PROBES:-200}"
+  n="${PROBES:-100000}"
   # The probe must ASSERT a tpu platform inside python: a CPU-fallback
   # init also exits 0, and the captured warning text can even contain the
   # string "TPU" — rc is the only trustworthy signal.
@@ -59,9 +66,24 @@ ds = jax.devices()
 assert any(d.platform == 'tpu' for d in ds), ds
 print(ds); print(jnp.arange(8).sum())
 " > "$OUT/probe.last" 2>&1 && { ok=1; break; }
+    if [ $((i % 5)) -eq 0 ]; then
+      el=$(( ($(date +%s) - START_EPOCH) / 36 ))
+      printf 'probes=%d elapsed_hours=%d.%02d last_probe_utc=%s status=waiting\n' \
+        "$i" $((el / 100)) $((el % 100)) "$(date -u +%H:%M:%S)" > "$OUT/HEARTBEAT"
+      sync_mirror
+    fi
     [ "$i" -lt "$n" ] && sleep 180
   done
-  [ "$ok" = 1 ] || { log "TPU never answered; giving up"; exit 1; }
+  if [ "$ok" != 1 ]; then
+    el=$(( ($(date +%s) - START_EPOCH) / 36 ))
+    printf 'GAVE UP after %d probes over %d.%02d hours (PROBES horizon hit)\n' \
+      "$n" $((el / 100)) $((el % 100)) > "$OUT/GAVE_UP"
+    log "TPU never answered after $n probes; giving up"
+    exit 1
+  fi
+  el=$(( ($(date +%s) - START_EPOCH) / 36 ))
+  printf 'probes_until_up=%d elapsed_hours=%d.%02d status=TPU_UP\n' \
+    "$i" $((el / 100)) $((el % 100)) > "$OUT/HEARTBEAT"
 fi
 log "TPU is up; starting sequence"
 
@@ -109,4 +131,6 @@ timeout 3600 python scripts/table_bench.py > "$OUT/table.out" 2>&1; log "rc=$?"
 
 log "10. profiled k=10 run (XLA trace for next-round tuning, resilient)"
 timeout 7200 python scripts/adv_bench.py 10 $RES --attempt-timeout 1800 --once --profile "$OUT/trace_k10" --checkpoint "$OUT/ck/prof" > "$OUT/k10_profiled.out" 2>&1; log "rc=$?"
+log "10b. trace summary (top sinks + busy/idle split)"
+timeout 600 python scripts/trace_summary.py "$OUT/trace_k10" > "$OUT/trace_summary.out" 2>&1; log "rc=$?"
 log "SEQUENCE COMPLETE"

@@ -1,0 +1,205 @@
+"""Summarize a ``jax.profiler.trace`` capture: top time sinks + busy/idle.
+
+Usage: python scripts/trace_summary.py TRACE_DIR [--top N] [--json]
+
+Reads the Chrome-format ``*.trace.json.gz`` that every capture writes
+(alongside the xplane.pb, which needs profiler protos this image's
+protobuf can't load) and answers the two questions the on-chip tuning
+loop needs (VERDICT r4 #3):
+
+1. Where does the time go? Top-N op groups by summed duration, per
+   device/process track, with ``sort.12``/``sort.13`` style suffixes
+   merged into one group and a coarse phase tag (sort/scatter/fold/...)
+   derived from the op name.
+2. Is the chip BUSY or WAITING? Per-track busy fraction over the trace
+   span.  The r4 roofline put on-chip k=10 at ~1-2% of v5e peaks; this
+   splits that deficit into "ops are slow" (high busy, long ops) vs
+   "dispatch/latency gaps" (low busy) — which decides whether the next
+   lever is kernel work or latency work.
+
+Works on any capture (CPU or TPU); the runbook runs it automatically
+after the profiled k=10 step so the analysis lands in the mirror even if
+the tunnel answers after the builder session ends.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+# Coarse phase classification by op-name substring.  TPU traces name ops
+# after the HLO (fusion.N, sort.N, ...); the fusion bucket is opaque but
+# sorts/scatters/while-overhead are named, which is enough to arbitrate
+# the r4 question (scatter-dedup vs sort-dedup vs fold cost).
+_PHASES = (
+    ("sort", "sort"),
+    ("scatter", "scatter"),
+    ("gather", "gather"),
+    ("reduce", "reduce"),
+    ("convert", "convert"),
+    ("copy", "copy"),
+    ("transpose", "copy"),
+    ("while", "loop-ctl"),
+    ("condition", "loop-ctl"),
+    ("tuple", "loop-ctl"),
+    ("dynamic-update", "dus"),
+    ("dynamic_update", "dus"),
+    ("dynamic-slice", "slice"),
+    ("dynamic_slice", "slice"),
+    ("slice", "slice"),
+    ("iota", "iota"),
+    ("fusion", "fusion"),
+    ("custom-call", "custom-call"),
+    ("custom_call", "custom-call"),
+    ("infeed", "transfer"),
+    ("outfeed", "transfer"),
+    ("transfer", "transfer"),
+    ("dot", "matmul"),
+)
+
+_SUFFIX = re.compile(r"[._]\d+$")
+
+
+def _phase(name: str) -> str:
+    low = name.lower()
+    for needle, tag in _PHASES:
+        if needle in low:
+            return tag
+    return "other"
+
+
+def latest_capture(trace_dir: str) -> str | None:
+    """Newest ``plugins/profile/<ts>`` session dir with a chrome trace."""
+    pat = os.path.join(trace_dir, "plugins", "profile", "*")
+    sessions = sorted(d for d in glob.glob(pat) if os.path.isdir(d))
+    for d in reversed(sessions):
+        if glob.glob(os.path.join(d, "*.trace.json.gz")):
+            return d
+    return None
+
+
+def summarize(session_dir: str, top: int = 15) -> dict:
+    events: list[dict] = []
+    pids: dict[tuple, str] = {}
+    for path in sorted(glob.glob(os.path.join(session_dir, "*.trace.json.gz"))):
+        host = os.path.basename(path).split(".")[0]
+        d = json.load(gzip.open(path, "rt"))
+        for e in d.get("traceEvents", []):
+            e["_host"] = host
+            events.append(e)
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[(e["_host"], e["pid"])] = e["args"].get("name", "?")
+
+    # Only complete ('X') events carry durations (us).
+    per_track: dict[str, dict] = {}
+    op_groups: dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter
+    )
+    op_counts: dict[str, collections.Counter] = collections.defaultdict(
+        collections.Counter
+    )
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        track = pids.get((e["_host"], e["pid"]), str(e["pid"]))
+        t = per_track.setdefault(
+            track, {"busy_us": 0.0, "t0": float("inf"), "t1": 0.0, "n": 0}
+        )
+        ts, dur = float(e.get("ts", 0.0)), float(e["dur"])
+        t["busy_us"] += dur
+        t["t0"] = min(t["t0"], ts)
+        t["t1"] = max(t["t1"], ts + dur)
+        t["n"] += 1
+        group = _SUFFIX.sub("", e["name"])
+        op_groups[track][group] += dur
+        op_counts[track][group] += 1
+
+    tracks = {}
+    for track, t in per_track.items():
+        span = max(t["t1"] - t["t0"], 1e-9)
+        # busy_us can exceed span on tracks with nested/overlapping events
+        # (host python stacks); it is exact on flat device op tracks, which
+        # are the ones the busy-fraction question is about.
+        tracks[track] = {
+            "events": t["n"],
+            "span_ms": round(span / 1e3, 3),
+            "busy_ms": round(t["busy_us"] / 1e3, 3),
+            "busy_frac": round(min(t["busy_us"] / span, 1.0), 4),
+            "top_ops": [
+                {
+                    "op": op,
+                    "total_ms": round(dur / 1e3, 3),
+                    "count": op_counts[track][op],
+                    "phase": _phase(op),
+                }
+                for op, dur in op_groups[track].most_common(top)
+            ],
+            "phase_ms": {
+                ph: round(ms / 1e3, 3)
+                for ph, ms in sorted(
+                    collections.Counter(
+                        {
+                            ph: sum(
+                                d
+                                for op, d in op_groups[track].items()
+                                if _phase(op) == ph
+                            )
+                            for ph in {_phase(op) for op in op_groups[track]}
+                        }
+                    ).items(),
+                    key=lambda kv: -kv[1],
+                )
+            },
+        }
+    return {"session": session_dir, "tracks": tracks}
+
+
+def render(summary: dict) -> str:
+    out = [f"# trace summary: {summary['session']}"]
+    # Device tracks first (TPU/accelerator), host threads after.
+    def key(kv):
+        name = kv[0].lower()
+        return (0 if ("tpu" in name or "xla" in name or "device" in name) else 1, name)
+
+    for track, t in sorted(summary["tracks"].items(), key=key):
+        out.append(
+            f"\n## {track}: {t['events']} events, span {t['span_ms']:.1f} ms, "
+            f"busy {t['busy_ms']:.1f} ms ({t['busy_frac']*100:.1f}%)"
+        )
+        out.append("   phase totals: " + ", ".join(
+            f"{ph}={ms:.1f}ms" for ph, ms in t["phase_ms"].items()
+        ))
+        for o in t["top_ops"]:
+            out.append(
+                f"   {o['total_ms']:10.2f} ms  x{o['count']:<6d} "
+                f"[{o['phase']:<10s}] {o['op'][:70]}"
+            )
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("trace_dir")
+    ap.add_argument("--top", type=int, default=15)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    session = latest_capture(args.trace_dir)
+    if session is None:
+        print(f"no *.trace.json.gz under {args.trace_dir}/plugins/profile/*",
+              file=sys.stderr)
+        return 1
+    s = summarize(session, top=args.top)
+    print(json.dumps(s) if args.json else render(s))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,268 @@
+"""Production-shape multi-chip correctness (VERDICT r4 #6).
+
+The toy-shape sharded tests (test_device.py) prove the mesh path compiles
+and agrees at small widths; these prove it at the scale the chip will
+actually see: the k=10-class adversarial instance whose frontier peaks at
+410 971 rows (>= 2^18) — the same state space as the BASELINE.md headline
+regime (batch=1 keeps the space identical and drops only fold cost,
+BASELINE.md "Layer-cost apportionment") — composed with checkpoint
+interrupt/resume, the HBM chunked tier, and the out-of-core spill.
+
+Composition map (why each arm is shaped the way it is):
+
+- sharded + in-bucket + checkpoint-resume: the multi-chip production path
+  for frontiers that fit the per-device bucket.
+- chunked tier + checkpoint-resume runs UNSHARDED by design: under a mesh
+  the chunked middle tier is deliberately disabled
+  (checker/device.py:1581-1592) — sharding already divides the expansion
+  working set per device, and chunk slices across the sharded frontier
+  axis would force cross-shard gathers; aggregate-HBM growth comes from
+  adding devices.  The sharded out-of-bucket production path is the
+  spill, covered below.
+- sharded + spill + snapshot-resume: the mesh path past the bucket.
+
+Slow (minutes, big compiles): opt-in via S2VTPU_PROD_MESH=1.  CI runs it
+as its own step; `make test-fast` never sees it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("S2VTPU_PROD_MESH") != "1",
+    reason="production-shape mesh suite is opt-in: set S2VTPU_PROD_MESH=1",
+)
+
+import jax
+import numpy as np
+
+from helpers import assert_valid_linearization
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome
+from s2_verification_tpu.collector.adversarial import adversarial_events
+
+K = 10
+PEAK_ROWS = 410_971  # measured frontier peak of this instance (BASELINE.md)
+BUCKET = 1 << 19  # in-bucket arm: peak fits (410 971 < 524 288)
+SMALL_BUCKET = 1 << 18  # out-of-bucket arms: peak overflows (> 262 144)
+START = 1 << 12
+# Sharded arms start at the production bucket: every escalation level
+# compiles its own GSPMD-partitioned program (minutes each on a small
+# host), and the x4 ladder is already exercised sharded at toy widths
+# (test_device.py).  What these arms add is the production WIDTH.
+START_SHARDED = 1 << 18
+
+
+@pytest.fixture(scope="module")
+def hist():
+    return prepare(adversarial_events(K, batch=1, seed=0))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = jax.devices()
+    assert len(devices) >= 8, "conftest must provision the virtual 8-device mesh"
+    from jax.sharding import Mesh
+
+    return Mesh(np.asarray(devices[:8]), ("fr",))
+
+
+@pytest.fixture(scope="module")
+def unsharded(hist):
+    """Reference arm: one in-bucket exhaustive run, witness validated."""
+    from s2_verification_tpu.checker.device import check_device
+
+    res = check_device(
+        hist,
+        max_frontier=BUCKET,
+        start_frontier=START,
+        beam=False,
+        collect_stats=True,
+        witness=True,
+    )
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.max_frontier >= 1 << 18, res.stats.max_frontier
+    assert res.linearization is not None
+    assert_valid_linearization(hist, res.linearization)
+    return res
+
+
+def _interrupt_after(n_calls: int):
+    """Patch device.run_search to preempt after ``n_calls`` segments.
+
+    check_device snapshots only after a segment RETURNS, so the preempt
+    fires inside call ``n_calls`` — the snapshot on disk is then from
+    call ``n_calls - 1`` (use n_calls >= 2 to guarantee one exists).
+    """
+    import s2_verification_tpu.checker.device as dev
+
+    real_run = dev.run_search
+    calls = {"n": 0}
+
+    def interrupting(*a, **kw):
+        calls["n"] += 1
+        out = real_run(*a, **kw)
+        if calls["n"] == n_calls:
+            raise KeyboardInterrupt
+        return out
+
+    return real_run, interrupting
+
+
+def _interrupt_when_snapshot_past(ck: str, threshold: int):
+    """Patch device.run_search to preempt once the on-disk snapshot's
+    frontier width exceeds ``threshold`` — call counts can't target the
+    big tier robustly (escalation stops and per-layer segments both
+    consume calls), but the snapshot width says exactly where we are."""
+    import s2_verification_tpu.checker.device as dev
+    from s2_verification_tpu.checker.checkpoint import load_checkpoint
+
+    real_run = dev.run_search
+
+    def interrupting(*a, **kw):
+        if os.path.exists(ck) and load_checkpoint(ck).f > threshold:
+            raise KeyboardInterrupt
+        return real_run(*a, **kw)
+
+    return real_run, interrupting
+
+
+def test_prodmesh_sharded_checkpoint_resume_matches_unsharded(
+    hist, mesh, unsharded, tmp_path
+):
+    """Sharded run preempted mid-search, resumed sharded: verdict + witness
+    must match the unsharded reference at the 410k-row production width."""
+    import s2_verification_tpu.checker.device as dev
+
+    ck = str(tmp_path / "prod.ckpt")
+    # Call 1 (2-layer segment at the 2^18 bucket) returns and snapshots;
+    # the preempt fires inside call 2, leaving committed work to resume.
+    real_run, interrupting = _interrupt_after(2)
+    dev.run_search = interrupting
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            dev.check_device(
+                hist,
+                max_frontier=BUCKET,
+                start_frontier=START_SHARDED,
+                beam=False,
+                mesh=mesh,
+                checkpoint_path=ck,
+                checkpoint_every=2,
+                witness=True,
+            )
+    finally:
+        dev.run_search = real_run
+    assert os.path.exists(ck)
+
+    res = dev.check_device(
+        hist,
+        max_frontier=BUCKET,
+        start_frontier=START_SHARDED,
+        beam=False,
+        mesh=mesh,
+        checkpoint_path=ck,
+        checkpoint_every=64,
+        collect_stats=True,
+        witness=True,
+    )
+    assert res.outcome == unsharded.outcome == CheckOutcome.OK
+    assert not os.path.exists(ck)  # conclusive verdict spends the snapshot
+    assert res.stats.max_frontier >= 1 << 18
+    assert res.linearization is not None
+    assert_valid_linearization(hist, res.linearization)
+    # Witnesses are linearizations of the same history; both must place
+    # every op exactly once (equal length), though order may differ.
+    assert len(res.linearization) == len(unsharded.linearization)
+
+
+def test_prodmesh_chunked_tier_checkpoint_resume(hist, unsharded, tmp_path):
+    """HBM chunked tier at production width, preempted and resumed.
+
+    Unsharded on purpose: the chunked tier is mesh-exclusive by design
+    (checker/device.py:1581-1592) — see module docstring.
+    """
+    import s2_verification_tpu.checker.device as dev
+    from s2_verification_tpu.checker.checkpoint import load_checkpoint
+
+    ck = str(tmp_path / "chunk.ckpt")
+    # Preempt at the first segment AFTER a snapshot from the big tier
+    # (frontier wider than the expansion bucket) has landed on disk.
+    real_run, interrupting = _interrupt_when_snapshot_past(ck, SMALL_BUCKET)
+    dev.run_search = interrupting
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            dev.check_device(
+                hist,
+                max_frontier=SMALL_BUCKET,
+                start_frontier=START,
+                beam=False,
+                device_rows_cap=1 << 19,
+                checkpoint_path=ck,
+                checkpoint_every=1,
+                witness=True,
+            )
+    finally:
+        dev.run_search = real_run
+    assert os.path.exists(ck)
+    saved = load_checkpoint(ck)
+    assert saved.f > SMALL_BUCKET  # the snapshot is from the big tier
+
+    res = dev.check_device(
+        hist,
+        max_frontier=SMALL_BUCKET,
+        start_frontier=START,
+        beam=False,
+        device_rows_cap=1 << 19,
+        checkpoint_path=ck,
+        checkpoint_every=4,
+        collect_stats=True,
+        witness=True,
+    )
+    assert res.outcome == unsharded.outcome == CheckOutcome.OK
+    assert res.stats.max_frontier >= 1 << 18
+    assert res.linearization is not None
+    assert_valid_linearization(hist, res.linearization)
+
+
+def test_prodmesh_sharded_spill_snapshot_resume(hist, mesh, unsharded, tmp_path):
+    """Sharded out-of-bucket production path: spill to host RAM, hit the
+    host cap (UNKNOWN + snapshot), resume from the snapshot under the
+    mesh to the conclusive verdict."""
+    from s2_verification_tpu.checker.device import check_device
+
+    ck = str(tmp_path / "spill.ckpt")
+    res = check_device(
+        hist,
+        max_frontier=SMALL_BUCKET,
+        start_frontier=START_SHARDED,
+        beam=False,
+        mesh=mesh,
+        spill=True,
+        spill_host_cap=1 << 18,  # < 410k peak: forces the capped UNKNOWN
+        checkpoint_path=ck,
+        witness=True,
+    )
+    assert res.outcome == CheckOutcome.UNKNOWN
+    assert os.path.exists(ck + ".spill.npz")
+
+    res = check_device(
+        hist,
+        max_frontier=SMALL_BUCKET,
+        start_frontier=START_SHARDED,
+        beam=False,
+        mesh=mesh,
+        spill=True,
+        spill_host_cap=1 << 26,
+        checkpoint_path=ck,
+        collect_stats=True,
+        witness=True,
+    )
+    assert res.outcome == unsharded.outcome == CheckOutcome.OK
+    assert not os.path.exists(ck + ".spill.npz")
+    assert res.stats.max_frontier >= 1 << 18
+    assert res.linearization is not None
+    assert_valid_linearization(hist, res.linearization)
