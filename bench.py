@@ -74,6 +74,15 @@ CPU_WALL_S = 1800.0
 NATIVE_WALL_S = {8: 3.4, 9: 24.7, 10: 85.4, 11: 391.2, 12: CPU_WALL_S}
 
 
+def _host_cpus() -> int:
+    """CPUs actually available to this process (affinity/cgroup-aware),
+    so cross-round host numbers self-describe their parallelism budget."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        return os.cpu_count() or 1
+
+
 def _zero_line(note: str) -> int:
     print(f"# {note}", file=sys.stderr)
     print(
@@ -84,6 +93,7 @@ def _zero_line(note: str) -> int:
                 "unit": "ops/s",
                 "vs_baseline": 0.0,
                 "backend": "none",
+                "host_cpus": _host_cpus(),
             }
         ),
         flush=True,
@@ -414,6 +424,10 @@ def north_star() -> int:
     target_s = 10.0  # BASELINE.json north star for this config
     value = n_ops / dev_s
     backend = _backend_marker()
+    # host_cpus: cross-round host numbers are only comparable when the
+    # host is — r2-r4 ran on multicore boxes, r5's on ONE core, and a
+    # cpu-fallback ops/s without the core count invites false
+    # regression/progress reads (BASELINE.md measurement discipline).
     print(
         json.dumps(
             {
@@ -422,6 +436,7 @@ def north_star() -> int:
                 "unit": "ops/s",
                 "vs_baseline": round(target_s / dev_s, 3),
                 "backend": backend,
+                "host_cpus": _host_cpus(),
             }
         ),
         flush=True,
@@ -534,6 +549,7 @@ def adversarial_line() -> None:
                     if native_wall is not None
                     else 0.0,
                     "backend": _backend_marker(),
+                    "host_cpus": _host_cpus(),
                 }
             ),
             file=sys.stderr,
