@@ -630,6 +630,7 @@ def mesh_scaling(n: int) -> int:
                 "unit": "x",
                 "vs_baseline": 1.0,
                 "scaling": not on_cpu,
+                "host_cpus": _host_cpus(),
             }
         )
     )
