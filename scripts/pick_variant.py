@@ -1,6 +1,6 @@
 """Summarize the on-chip runbook's variant matrix and name the winner.
 
-Usage: python scripts/pick_variant.py [DIR]   (default /tmp/onchip_r4)
+Usage: python scripts/pick_variant.py [DIR]   (default /tmp/onchip_r5)
 
 Reads the per-step artifacts the runbook leaves behind — the k=10
 dedup/fold variant results (resilient driver JSONs + stdout), the
@@ -57,7 +57,7 @@ def _grep_outcome(path: str, pat: str) -> list[str]:
 
 
 def main() -> int:
-    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/onchip_r4"
+    out = sys.argv[1] if len(sys.argv) > 1 else "/tmp/onchip_r5"
     if not os.path.isdir(out):
         print(f"no results dir at {out}")
         return 1
@@ -126,6 +126,14 @@ def main() -> int:
 
     traces = glob.glob(os.path.join(out, "trace_k10", "**", "*.pb"), recursive=True)
     print(f"\n## profiler trace: {'captured' if traces else '(pending)'}")
+    summary = os.path.join(out, "trace_summary.out")
+    if os.path.exists(summary):
+        # First lines carry the device track's busy/idle split and top
+        # sinks (scripts/trace_summary.py) — the "is the chip slow or
+        # waiting" answer belongs in the decision table.
+        with open(summary, errors="replace") as f:
+            for line in list(f)[:24]:
+                print(f"  {line.rstrip()}")
     return 0
 
 
