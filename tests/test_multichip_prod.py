@@ -10,8 +10,8 @@ interrupt/resume, the HBM chunked tier, and the out-of-core spill.
 
 Composition map (why each arm is shaped the way it is):
 
-- sharded + in-bucket + checkpoint-resume: the multi-chip production path
-  for frontiers that fit the per-device bucket.
+- sharded + checkpoint preempt/resume + spill: the multi-chip production
+  path — 2^18-wide sharded programs, peak layers streamed.
 - chunked tier + checkpoint-resume runs UNSHARDED by design: under a mesh
   the chunked middle tier is deliberately disabled
   (checker/device.py:1581-1592) — sharding already divides the expansion
@@ -20,6 +20,11 @@ Composition map (why each arm is shaped the way it is):
   adding devices.  The sharded out-of-bucket production path is the
   spill, covered below.
 - sharded + spill + snapshot-resume: the mesh path past the bucket.
+- sharded fully in-bucket (2^19 rows resident): S2VTPU_PROD_MESH_FULL=1
+  only — the GSPMD partitioning of the 2^19-bucket search program
+  measured >75 min of compile on a 1-core host (~25 min at 2^18;
+  superlinear in bucket width), so the default opt-in suite stays on
+  2^18-wide sharded programs.
 
 Slow (minutes, big compiles): opt-in via S2VTPU_PROD_MESH=1.  CI runs it
 as its own step; `make test-fast` never sees it.
@@ -130,29 +135,33 @@ def _interrupt_when_snapshot_past(ck: str, threshold: int):
     return real_run, interrupting
 
 
-def test_prodmesh_sharded_checkpoint_resume_matches_unsharded(
-    hist, mesh, unsharded, tmp_path
+def _preempt_then_resume_sharded(
+    hist, mesh, unsharded, ck: str, *, max_frontier: int, spill: bool,
+    min_peak: int,
 ):
-    """Sharded run preempted mid-search, resumed sharded: verdict + witness
-    must match the unsharded reference at the 410k-row production width."""
+    """Shared preempt/resume harness for the sharded arms.
+
+    Call 1 (2-layer segment at the starting bucket) returns and
+    snapshots; the preempt fires inside call 2, leaving committed work
+    to resume.  The resumed run must reproduce the unsharded reference:
+    verdict, witness validity, and witness length (both linearizations
+    place every op exactly once; order may differ)."""
     import s2_verification_tpu.checker.device as dev
 
-    ck = str(tmp_path / "prod.ckpt")
-    # Call 1 (2-layer segment at the 2^18 bucket) returns and snapshots;
-    # the preempt fires inside call 2, leaving committed work to resume.
+    kw = dict(
+        max_frontier=max_frontier,
+        start_frontier=START_SHARDED,
+        beam=False,
+        mesh=mesh,
+        spill=spill,
+        witness=True,
+    )
     real_run, interrupting = _interrupt_after(2)
     dev.run_search = interrupting
     try:
         with pytest.raises(KeyboardInterrupt):
             dev.check_device(
-                hist,
-                max_frontier=BUCKET,
-                start_frontier=START_SHARDED,
-                beam=False,
-                mesh=mesh,
-                checkpoint_path=ck,
-                checkpoint_every=2,
-                witness=True,
+                hist, checkpoint_path=ck, checkpoint_every=2, **kw
             )
     finally:
         dev.run_search = real_run
@@ -160,23 +169,40 @@ def test_prodmesh_sharded_checkpoint_resume_matches_unsharded(
 
     res = dev.check_device(
         hist,
-        max_frontier=BUCKET,
-        start_frontier=START_SHARDED,
-        beam=False,
-        mesh=mesh,
         checkpoint_path=ck,
         checkpoint_every=64,
         collect_stats=True,
-        witness=True,
+        **kw,
     )
     assert res.outcome == unsharded.outcome == CheckOutcome.OK
-    assert not os.path.exists(ck)  # conclusive verdict spends the snapshot
-    assert res.stats.max_frontier >= 1 << 18
+    # A conclusive verdict spends the snapshot(s).
+    assert not os.path.exists(ck)
+    assert not os.path.exists(ck + ".spill.npz")
+    assert res.stats.max_frontier >= min_peak
     assert res.linearization is not None
     assert_valid_linearization(hist, res.linearization)
-    # Witnesses are linearizations of the same history; both must place
-    # every op exactly once (equal length), though order may differ.
     assert len(res.linearization) == len(unsharded.linearization)
+
+
+def test_prodmesh_sharded_checkpoint_resume_matches_unsharded(
+    hist, mesh, unsharded, tmp_path
+):
+    """Sharded run preempted mid-search, resumed sharded: verdict + witness
+    must match the unsharded reference at the 410k-row production width.
+
+    Runs at the 2^18 bucket with spill for the peak layers: the 2^19
+    in-bucket sharded program is gated behind S2VTPU_PROD_MESH_FULL=1
+    (see test_prodmesh_sharded_inbucket_full) because its GSPMD compile
+    alone measured >75 minutes."""
+    _preempt_then_resume_sharded(
+        hist,
+        mesh,
+        unsharded,
+        str(tmp_path / "prod.ckpt"),
+        max_frontier=SMALL_BUCKET,
+        spill=True,
+        min_peak=1 << 18,
+    )
 
 
 def test_prodmesh_chunked_tier_checkpoint_resume(hist, unsharded, tmp_path):
@@ -226,6 +252,29 @@ def test_prodmesh_chunked_tier_checkpoint_resume(hist, unsharded, tmp_path):
     assert res.stats.max_frontier >= 1 << 18
     assert res.linearization is not None
     assert_valid_linearization(hist, res.linearization)
+
+
+@pytest.mark.skipif(
+    os.environ.get("S2VTPU_PROD_MESH") != "1"
+    or os.environ.get("S2VTPU_PROD_MESH_FULL") != "1",
+    reason="needs BOTH S2VTPU_PROD_MESH=1 and S2VTPU_PROD_MESH_FULL=1 "
+    "(the 2^19-bucket GSPMD compile alone measured >75 min)",
+)
+def test_prodmesh_sharded_inbucket_full(hist, mesh, unsharded, tmp_path):
+    """The whole 410k-row peak RESIDENT on the sharded mesh (no spill):
+    the shape an 8-chip slice would run in-core.  Compile-bound — the
+    GSPMD partitioning of the 2^19-bucket program alone took >75 min on
+    the round-5 1-core host — hence its own opt-in flag (additive to
+    S2VTPU_PROD_MESH=1)."""
+    _preempt_then_resume_sharded(
+        hist,
+        mesh,
+        unsharded,
+        str(tmp_path / "full.ckpt"),
+        max_frontier=BUCKET,
+        spill=False,
+        min_peak=PEAK_ROWS,
+    )
 
 
 def test_prodmesh_sharded_spill_snapshot_resume(hist, mesh, unsharded, tmp_path):
