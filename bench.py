@@ -708,6 +708,14 @@ def _reexec_mesh(n: int) -> int:
         if "xla_force_host_platform_device_count" not in f
     ]
     flags.append(f"--xla_force_host_platform_device_count={n}")
+    # Fewer cores than virtual devices: per-device Eigen pools spin-wait
+    # and thrash (sharded step >17 min vs 41.7 s single-threaded on the
+    # round-5 1-core box).  Same guard as tests/conftest.py.
+    if _host_cpus() < n and not any("multi_thread_eigen" in f for f in flags):
+        flags += [
+            "--xla_cpu_multi_thread_eigen=false",
+            "intra_op_parallelism_threads=1",
+        ]
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
     return subprocess.run(

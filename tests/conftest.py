@@ -12,7 +12,30 @@ import os
 os.environ["JAX_PLATFORMS"] = os.environ.get("S2VTPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import re as _re
+
+
+def _effective_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):  # non-Linux fallback
+        return os.cpu_count() or 1
+
+
+_n_dev = int(
+    _re.search(r"xla_force_host_platform_device_count=(\d+)", flags).group(1)
+)
+# On a host with fewer cores than virtual devices, each device's Eigen
+# thread pool SPIN-WAITS for work it rarely gets scheduled to do: a
+# sharded execution that takes seconds single-threaded burned >17 min
+# before this guard (measured round 5, 1-core box; 41.7 s after).
+# Multicore hosts keep intra-op parallelism — the guard only fires when
+# the pools would oversubscribe the machine.
+if _effective_cpus() < _n_dev and "multi_thread_eigen" not in flags:
+    flags += " --xla_cpu_multi_thread_eigen=false intra_op_parallelism_threads=1"
+os.environ["XLA_FLAGS"] = flags
 
 import sys
 
