@@ -10,24 +10,26 @@ interrupt/resume, the HBM chunked tier, and the out-of-core spill.
 
 Composition map (why each arm is shaped the way it is):
 
-- sharded + checkpoint preempt/resume + spill: the multi-chip production
-  path — 2^18-wide sharded programs, peak layers streamed.
-- chunked tier + checkpoint-resume runs UNSHARDED by design: under a mesh
-  the chunked middle tier is deliberately disabled
+- DEFAULT (S2VTPU_PROD_MESH=1): one sharded arm — spill to host past the
+  2^18 bucket, preempted by the spill host-row cap (UNKNOWN + snapshot),
+  resumed from the snapshot under the mesh to the conclusive verdict,
+  witness equality against the unsharded reference — plus the unsharded
+  chunked-tier preempt/resume arm.  The chunked tier runs UNSHARDED by
+  design: under a mesh it is deliberately disabled
   (checker/device.py:1581-1592) — sharding already divides the expansion
   working set per device, and chunk slices across the sharded frontier
   axis would force cross-shard gathers; aggregate-HBM growth comes from
   adding devices.  The sharded out-of-bucket production path is the
-  spill, covered below.
-- sharded + spill + snapshot-resume: the mesh path past the bucket.
-- sharded fully in-bucket (2^19 rows resident): S2VTPU_PROD_MESH_FULL=1
-  only — the GSPMD partitioning of the 2^19-bucket search program
-  measured >75 min of compile on a 1-core host (~25 min at 2^18;
-  superlinear in bucket width), so the default opt-in suite stays on
-  2^18-wide sharded programs.
+  spill.
+- FULL (S2VTPU_PROD_MESH_FULL=1, additive): the KeyboardInterrupt
+  preempt/resume variant of the sharded spill arm, and the fully
+  in-bucket 2^19 arm (peak resident, no spill).  Every full sharded
+  search at the 410k-row width costs ~8x-serialized execution per
+  virtual device on a core-starved host (see conftest's Eigen guard) —
+  the default suite runs two such searches, FULL adds four more.
 
-Slow (minutes, big compiles): opt-in via S2VTPU_PROD_MESH=1.  CI runs it
-as its own step; `make test-fast` never sees it.
+Slow (tens of minutes on few cores): opt-in via S2VTPU_PROD_MESH=1.
+CI runs it as its own step; `make test-fast` never sees it.
 """
 
 from __future__ import annotations
@@ -184,16 +186,23 @@ def _preempt_then_resume_sharded(
     assert len(res.linearization) == len(unsharded.linearization)
 
 
+_FULL_GATE = pytest.mark.skipif(
+    os.environ.get("S2VTPU_PROD_MESH") != "1"
+    or os.environ.get("S2VTPU_PROD_MESH_FULL") != "1",
+    reason="needs BOTH S2VTPU_PROD_MESH=1 and S2VTPU_PROD_MESH_FULL=1 "
+    "(each extra full sharded search costs tens of minutes on few cores)",
+)
+
+
+@_FULL_GATE
 def test_prodmesh_sharded_checkpoint_resume_matches_unsharded(
     hist, mesh, unsharded, tmp_path
 ):
-    """Sharded run preempted mid-search, resumed sharded: verdict + witness
-    must match the unsharded reference at the 410k-row production width.
-
-    Runs at the 2^18 bucket with spill for the peak layers: the 2^19
-    in-bucket sharded program is gated behind S2VTPU_PROD_MESH_FULL=1
-    (see test_prodmesh_sharded_inbucket_full) because its GSPMD compile
-    alone measured >75 minutes."""
+    """Sharded run preempted mid-search (simulated preemption), resumed
+    sharded: verdict + witness must match the unsharded reference at the
+    410k-row production width.  FULL-gated: the default suite's spill-cap
+    arm already covers sharded resume at this width with half the
+    searches; this adds the KeyboardInterrupt-preempt path."""
     _preempt_then_resume_sharded(
         hist,
         mesh,
@@ -254,18 +263,11 @@ def test_prodmesh_chunked_tier_checkpoint_resume(hist, unsharded, tmp_path):
     assert_valid_linearization(hist, res.linearization)
 
 
-@pytest.mark.skipif(
-    os.environ.get("S2VTPU_PROD_MESH") != "1"
-    or os.environ.get("S2VTPU_PROD_MESH_FULL") != "1",
-    reason="needs BOTH S2VTPU_PROD_MESH=1 and S2VTPU_PROD_MESH_FULL=1 "
-    "(the 2^19-bucket GSPMD compile alone measured >75 min)",
-)
+@_FULL_GATE
 def test_prodmesh_sharded_inbucket_full(hist, mesh, unsharded, tmp_path):
     """The whole 410k-row peak RESIDENT on the sharded mesh (no spill):
-    the shape an 8-chip slice would run in-core.  Compile-bound — the
-    GSPMD partitioning of the 2^19-bucket program alone took >75 min on
-    the round-5 1-core host — hence its own opt-in flag (additive to
-    S2VTPU_PROD_MESH=1)."""
+    the shape an 8-chip slice would run in-core.  The most expensive arm
+    (widest sharded programs, no streaming) — FULL-gated."""
     _preempt_then_resume_sharded(
         hist,
         mesh,
@@ -278,9 +280,11 @@ def test_prodmesh_sharded_inbucket_full(hist, mesh, unsharded, tmp_path):
 
 
 def test_prodmesh_sharded_spill_snapshot_resume(hist, mesh, unsharded, tmp_path):
-    """Sharded out-of-bucket production path: spill to host RAM, hit the
-    host cap (UNKNOWN + snapshot), resume from the snapshot under the
-    mesh to the conclusive verdict."""
+    """The DEFAULT sharded production arm: spill to host RAM past the
+    2^18 bucket, preempted by the host-row cap (UNKNOWN + snapshot on
+    disk — a real mid-search interruption, no monkeypatching), resumed
+    from the snapshot under the mesh to the conclusive verdict, witness
+    checked against the unsharded reference."""
     from s2_verification_tpu.checker.device import check_device
 
     ck = str(tmp_path / "spill.ckpt")
@@ -315,3 +319,5 @@ def test_prodmesh_sharded_spill_snapshot_resume(hist, mesh, unsharded, tmp_path)
     assert res.stats.max_frontier >= 1 << 18
     assert res.linearization is not None
     assert_valid_linearization(hist, res.linearization)
+    # Both witnesses place every op exactly once; order may differ.
+    assert len(res.linearization) == len(unsharded.linearization)
