@@ -284,7 +284,14 @@ def test_prodmesh_sharded_spill_snapshot_resume(hist, mesh, unsharded, tmp_path)
     2^18 bucket, preempted by the host-row cap (UNKNOWN + snapshot on
     disk — a real mid-search interruption, no monkeypatching), resumed
     from the snapshot under the mesh to the conclusive verdict, witness
-    checked against the unsharded reference."""
+    checked against the unsharded reference.
+
+    Cost note (measured round 5): on a 1-CORE host the two sharded
+    searches exceed 3 h wall — 8 virtual devices serialized on one core.
+    Budget ~25-45 min on a 4-core CI runner.  The same composition is
+    validated at toy width every suite run (test_device.py
+    test_spill_sharded_over_mesh, test_checkpoint.py
+    test_spill_checkpoint_resume)."""
     from s2_verification_tpu.checker.device import check_device
 
     ck = str(tmp_path / "spill.ckpt")
