@@ -9,6 +9,13 @@
               against the in-process fault-injecting fake S2 (this
               environment has no network): writes
               ``./data/records.<epoch>.jsonl`` and prints the path.
+``serve``   — run ``verifyd``, the resident batched verification daemon
+              (service/), on a unix socket: admission queue with explicit
+              backpressure, shape-grouped scheduling (compiles amortize
+              across requests), verdict cache, supervised device jobs.
+``submit``  — send one history to a running ``verifyd`` and exit with the
+              ``check`` exit code for its verdict (75 = queue full after
+              retries, 69 = no daemon on the socket).
 
 Backends for ``check``:
 
@@ -371,6 +378,116 @@ def _cmd_collect(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    from .service.daemon import Verifyd, VerifydConfig
+
+    if os.path.exists(args.socket):
+        # A stale socket file from a crashed daemon only a clean exit
+        # removes; refusing with a clear message beats a bind error.
+        log.error(
+            "%s already exists — another verifyd running? (remove the file "
+            "if it is stale)",
+            args.socket,
+        )
+        return USAGE_EXIT
+    cfg = VerifydConfig(
+        socket_path=args.socket,
+        queue_depth=args.queue_depth,
+        workers=args.workers,
+        batch_max=args.batch_max,
+        time_budget_s=args.time_budget,
+        device=args.device,
+        out_dir=args.out_dir,
+        no_viz=args.no_viz,
+        stats_log=args.stats_log or None,
+        device_rows=args.device_rows,
+    )
+    daemon = Verifyd(cfg)
+
+    import signal as _signal
+
+    def _stop(signum, frame):
+        log.info("signal %d: stopping verifyd", signum)
+        daemon.request_stop()
+
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, _stop)
+    return daemon.serve_forever()
+
+
+def _cmd_submit(args: argparse.Namespace) -> int:
+    from .service.client import VerifydBusy, VerifydClient, VerifydError
+    from .service.protocol import EXIT_BUSY, EXIT_UNAVAILABLE
+
+    if args.file == "-":
+        text = sys.stdin.read()
+    else:
+        try:
+            with open(args.file, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            log.error("failed to read history: %s", e)
+            return USAGE_EXIT
+    client = VerifydClient(args.socket)
+    try:
+        reply = client.submit_with_retry(
+            text,
+            client=args.client,
+            priority=args.priority,
+            no_viz=args.no_viz or None,
+            timeout=args.timeout,
+            retries=args.retries,
+        )
+    except VerifydBusy as e:
+        log.error(
+            "verifyd is at capacity (%s); retry after ~%.1fs",
+            e.msg,
+            e.retry_after_s,
+        )
+        return EXIT_BUSY
+    except VerifydError as e:
+        if e.cls == "DecodeError":
+            log.error("daemon rejected the history: %s", e.msg)
+            return USAGE_EXIT
+        log.error("submit failed: %s", e)
+        return EXIT_UNAVAILABLE
+    except (OSError, TimeoutError) as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e)
+        return EXIT_UNAVAILABLE
+
+    if args.stats:
+        import json as _json
+
+        line = {
+            "file": args.file,
+            "outcome": reply.get("outcome"),
+            "backend": reply.get("backend"),
+            "wall_s": reply.get("wall_s"),
+            "queue_wait_s": reply.get("queue_wait_s"),
+            "ops": reply.get("ops"),
+            "cached": reply.get("cached", False),
+            "shape": reply.get("shape"),
+        }
+        print(_json.dumps(line), flush=True)
+    art = reply.get("artifact")
+    if art:
+        log.info("visualization: %s", art)
+    verdict = reply.get("verdict")
+    outcome = reply.get("outcome")
+    if verdict == 0:
+        log.info(
+            "history is linearizable (%s, %ss%s)",
+            reply.get("backend"),
+            reply.get("wall_s"),
+            ", cached" if reply.get("cached") else "",
+        )
+    elif verdict == 1:
+        log.error("history is NOT linearizable (%s)", reply.get("backend"))
+    else:
+        log.error("check inconclusive (outcome %s)", outcome)
+    return verdict if verdict in (0, 1, 2) else USAGE_EXIT
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = _Parser(
         prog="s2-verification-tpu",
@@ -470,6 +587,104 @@ def build_parser() -> argparse.ArgumentParser:
         "in-process call path",
     )
     g.set_defaults(fn=_cmd_collect)
+
+    s = sub.add_parser(
+        "serve", help="run verifyd, the resident verification daemon"
+    )
+    s.add_argument(
+        "-socket", "--socket", required=True, help="unix-domain socket path"
+    )
+    s.add_argument(
+        "--queue-depth",
+        type=int,
+        default=64,
+        help="admission-queue bound; a full queue rejects with retry-after "
+        "instead of buffering (default 64)",
+    )
+    s.add_argument("--workers", type=int, default=1)
+    s.add_argument(
+        "--batch-max",
+        type=int,
+        default=16,
+        help="max jobs per shape group a worker drains back to back",
+    )
+    s.add_argument(
+        "-time-budget",
+        "--time-budget",
+        type=float,
+        default=10.0,
+        help="per-job CPU budget in seconds before device escalation; "
+        "0 = unbounded CPU, no escalation (default 10)",
+    )
+    s.add_argument(
+        "--device",
+        default="supervised",
+        choices=["supervised", "inline", "off"],
+        help="device escalation: 'supervised' (bounded child + checkpoint "
+        "resume; a wedged TPU degrades the job to CPU), 'inline' "
+        "(in-process), 'off'",
+    )
+    s.add_argument("-out-dir", "--out-dir", default="./porcupine-outputs")
+    s.add_argument(
+        "-no-viz",
+        "--no-viz",
+        action="store_true",
+        help="default jobs to skipping the HTML artifact",
+    )
+    s.add_argument(
+        "--stats-log",
+        default="-",
+        help="structured per-job event sink: a path, '-' for stderr "
+        "(default), or '' to silence",
+    )
+    s.add_argument(
+        "-device-rows",
+        "--device-rows",
+        type=int,
+        default=None,
+        help="device-resident frontier cap for escalated jobs",
+    )
+    s.set_defaults(fn=_cmd_serve, stats=False)
+
+    u = sub.add_parser("submit", help="submit one history to a running verifyd")
+    u.add_argument(
+        "-file", "--file", required=True, help="history JSONL path, '-' for stdin"
+    )
+    u.add_argument(
+        "-socket", "--socket", required=True, help="the daemon's socket path"
+    )
+    u.add_argument("--client", default="cli", help="client identity for the queue")
+    u.add_argument(
+        "--priority",
+        type=int,
+        default=10,
+        help="admission priority (lower = scheduled sooner; default 10)",
+    )
+    u.add_argument(
+        "--timeout",
+        type=float,
+        default=None,
+        help="seconds to wait for the verdict (default: wait)",
+    )
+    u.add_argument(
+        "--retries",
+        type=int,
+        default=0,
+        help="re-submissions after a queue-full reject, sleeping the "
+        "daemon's retry-after hint between attempts (default 0: fail "
+        "fast with exit 75)",
+    )
+    u.add_argument(
+        "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
+    )
+    u.add_argument(
+        "-stats",
+        "--stats",
+        action="store_true",
+        help="print one machine-readable JSON line (verdict, backend, "
+        "queue wait, cache hit) on stdout",
+    )
+    u.set_defaults(fn=_cmd_submit)
     return p
 
 

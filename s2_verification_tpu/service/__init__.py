@@ -1,0 +1,47 @@
+"""verifyd — a resident batched verification service.
+
+The one-shot CLI pays process start, history decode, backend selection,
+and (for the device engine) XLA compile on every ``check``; the daemon
+amortizes all four across requests.  Four cooperating pieces:
+
+- :mod:`.queue`     — bounded admission queue with per-client priority and
+                      explicit backpressure (reject-with-retry-after).
+- :mod:`.scheduler` — drains the queue in *shape groups* so the device
+                      engine's jitted executables (and the persistent
+                      compile cache, ``utils/cache.py``) are reused across
+                      requests; runs the ``auto`` portfolio per job.
+- :mod:`.cache`     — verdict cache keyed by the canonical chain-hash
+                      fingerprint of the prepared history: duplicates are
+                      answered in O(1).
+- :mod:`.supervise` — bounded-child/checkpoint-resume wrapper for device
+                      jobs (``checker/resilient.py`` + ``checkpoint.py``):
+                      one wedged TPU job degrades to CPU instead of taking
+                      the daemon down.
+
+:mod:`.daemon` ties them together behind a unix-domain socket speaking the
+same newline-delimited-JSON framing discipline as ``collector/socket_s2.py``;
+:mod:`.client` is the submit side; :mod:`.stats` emits per-job structured
+log events (queue wait, backend chosen, cache hit/miss, wall time).
+"""
+
+from .cache import VerdictCache, history_fingerprint
+from .client import VerifydBusy, VerifydClient, VerifydError
+from .daemon import Verifyd, VerifydConfig
+from .queue import AdmissionQueue, Job, QueueFull
+from .scheduler import shape_key
+from .stats import ServiceStats
+
+__all__ = [
+    "AdmissionQueue",
+    "Job",
+    "QueueFull",
+    "ServiceStats",
+    "Verifyd",
+    "VerifydBusy",
+    "VerifydClient",
+    "VerifydConfig",
+    "VerifydError",
+    "VerdictCache",
+    "history_fingerprint",
+    "shape_key",
+]

@@ -1,0 +1,78 @@
+"""Verdict cache: resubmitted histories are answered in O(1).
+
+The key is the canonical chain-hash fingerprint of the *prepared* history
+(``utils/hashing.py`` over ``checker/entries.prepare`` output): each
+search-relevant op is serialized to a canonical byte string and folded
+through the same ``chain_hash`` protocol the stream model itself uses, so
+byte-identical resubmissions — and re-collections that prepare to the
+same op sequence — share a key.  Trivial (elided) ops are deliberately
+excluded: they cannot change a verdict (entries.py docstring), so two
+histories differing only in definite failures share the cached answer.
+
+The cached value is the full reply payload (verdict, outcome, backend,
+artifact path), so a hit costs one dict lookup — no backend, no compile,
+no search.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..checker.entries import History
+from ..utils.hashing import chain_hash, record_hash
+
+__all__ = ["history_fingerprint", "VerdictCache"]
+
+_FP_VERSION = "v1"
+
+
+def history_fingerprint(hist: History) -> str:
+    """Canonical chain-hash fingerprint of a prepared history.
+
+    Folds the xxh3 of each op's canonical serialization (chain identity,
+    real-time window, input, output, pending-completion flag) through
+    ``chain_hash`` in op order — the same left-fold discipline as the
+    stream-hash protocol.  Everything the verdict depends on is covered:
+    op semantics via ``inp``/``out`` (dataclass reprs are deterministic),
+    real-time order via ``call``/``ret``, chain structure via
+    ``client_id``.
+    """
+    acc = 0
+    for op in hist.ops:
+        canon = (
+            f"{op.client_id}|{op.call}|{op.ret}|{op.pending}|"
+            f"{op.inp!r}|{op.out!r}"
+        )
+        acc = chain_hash(acc, record_hash(canon.encode("utf-8")))
+    return f"{_FP_VERSION}:{acc:016x}:{len(hist.ops)}"
+
+
+class VerdictCache:
+    """Thread-safe LRU of fingerprint → reply payload."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"cache capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, dict] = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, fingerprint: str) -> dict | None:
+        with self._lock:
+            payload = self._entries.get(fingerprint)
+            if payload is not None:
+                self._entries.move_to_end(fingerprint)
+                return dict(payload)
+            return None
+
+    def put(self, fingerprint: str, payload: dict) -> None:
+        with self._lock:
+            self._entries[fingerprint] = dict(payload)
+            self._entries.move_to_end(fingerprint)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)

@@ -1,0 +1,115 @@
+"""Client side of the verifyd protocol (the ``submit`` CLI's engine).
+
+Synchronous blocking sockets, one connection per request — the same
+connection discipline as the collector transport's setup path
+(``collector/socket_s2.py:snapshot_bodies``).  ``submit`` keeps its
+connection open until the daemon replies with the verdict; everything
+else answers immediately.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+
+from .protocol import ERR_QUEUE_FULL, encode_frame
+
+__all__ = ["VerifydError", "VerifydBusy", "VerifydClient"]
+
+
+class VerifydError(RuntimeError):
+    """The daemon answered with an error frame."""
+
+    def __init__(self, cls: str, msg: str, extra: dict | None = None) -> None:
+        super().__init__(f"{cls}: {msg}")
+        self.cls = cls
+        self.msg = msg
+        self.extra = extra or {}
+
+
+class VerifydBusy(VerifydError):
+    """Backpressure: the admission queue is full; retry after the hint."""
+
+    @property
+    def retry_after_s(self) -> float:
+        return float(self.extra.get("retry_after_s", 1.0))
+
+
+class VerifydClient:
+    def __init__(self, path: str, timeout: float | None = None) -> None:
+        self.path = path
+        #: default per-call timeout; submit calls may override (a verdict
+        #: on a hard history legitimately takes longer than a ping)
+        self.timeout = timeout
+
+    def _call(self, req: dict, timeout: float | None = None) -> dict:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(timeout if timeout is not None else self.timeout)
+            s.connect(self.path)
+            s.sendall(encode_frame(req))
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(1 << 16)
+                if not chunk:
+                    raise VerifydError(
+                        "ConnectionClosed", "daemon closed the connection mid-call"
+                    )
+                buf += chunk
+        resp = json.loads(buf)
+        if "err" in resp:
+            e = resp["err"]
+            cls = e.get("class", "InternalError")
+            exc = VerifydBusy if cls == ERR_QUEUE_FULL else VerifydError
+            raise exc(cls, e.get("msg", ""), e)
+        return resp["ok"]
+
+    # -- ops ----------------------------------------------------------------
+
+    def ping(self, timeout: float | None = 10.0) -> dict:
+        return self._call({"op": "ping"}, timeout=timeout)
+
+    def stats(self, timeout: float | None = 10.0) -> dict:
+        return self._call({"op": "stats"}, timeout=timeout)
+
+    def shutdown(self, timeout: float | None = 10.0) -> dict:
+        return self._call({"op": "shutdown"}, timeout=timeout)
+
+    def submit(
+        self,
+        history_text: str,
+        *,
+        client: str = "client",
+        priority: int = 10,
+        no_viz: bool | None = None,
+        timeout: float | None = None,
+    ) -> dict:
+        req: dict = {
+            "op": "submit",
+            "history": history_text,
+            "client": client,
+            "priority": priority,
+        }
+        if no_viz is not None:
+            req["no_viz"] = no_viz
+        return self._call(req, timeout=timeout)
+
+    def submit_with_retry(
+        self,
+        history_text: str,
+        *,
+        retries: int = 0,
+        max_retry_wait_s: float = 30.0,
+        **kw,
+    ) -> dict:
+        """``submit``, honoring backpressure: sleep the daemon's
+        retry-after hint (capped) between attempts, up to ``retries``
+        re-submissions; the final :class:`VerifydBusy` propagates."""
+        for attempt in range(retries + 1):
+            try:
+                return self.submit(history_text, **kw)
+            except VerifydBusy as e:
+                if attempt == retries:
+                    raise
+                time.sleep(min(e.retry_after_s, max_retry_wait_s))
+        raise AssertionError("unreachable")

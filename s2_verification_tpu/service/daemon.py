@@ -1,0 +1,324 @@
+"""verifyd: the resident verification daemon.
+
+Serves the :mod:`.protocol` over a unix-domain socket.  Like the
+collector's loopback S2 server (``collector/socket_s2.py``), the asyncio
+acceptor runs a private event loop on a daemon thread, so the daemon
+composes as a context manager in tests and as a foreground process under
+``s2-verification-tpu serve``.  Checking itself never runs on the event
+loop: the acceptor only decodes, consults the verdict cache, and admits
+into the bounded queue; :class:`~.scheduler.Scheduler` worker threads do
+the searching and resolve each submit's deferred reply through
+``call_soon_threadsafe``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import logging
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+
+from .. import version as _version
+from ..checker.entries import prepare
+from ..utils import events as ev
+from .cache import VerdictCache, history_fingerprint
+from .protocol import (
+    ERR_DECODE,
+    ERR_INTERNAL,
+    ERR_QUEUE_FULL,
+    ERR_SHUTTING_DOWN,
+    PROTOCOL_VERSION,
+    decode_frame,
+    encode_frame,
+    err,
+    ok,
+)
+from .queue import AdmissionQueue, Job, QueueFull
+from .scheduler import Scheduler, shape_key
+from .stats import ServiceStats
+
+__all__ = ["VerifydConfig", "Verifyd"]
+
+log = logging.getLogger("s2_verification_tpu.verifyd")
+
+
+@dataclass
+class VerifydConfig:
+    socket_path: str
+    queue_depth: int = 64
+    workers: int = 1  # 0 = admission only (test hook: nothing drains)
+    batch_max: int = 16
+    time_budget_s: float | None = 10.0  # per-job CPU budget; 0 = unbounded CPU
+    device: str = "supervised"  # supervised | inline | off
+    unbounded_close: bool = False
+    out_dir: str = "./porcupine-outputs"
+    no_viz: bool = False
+    cache_capacity: int = 4096
+    spool_dir: str | None = None
+    device_rows: int | None = None
+    attempt_timeout_s: float = 900.0
+    max_restarts: int = 2
+    #: structured-events sink: a path, "-" for stderr, or None (silent)
+    stats_log: str | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class Verifyd:
+    """The daemon.  ``with Verifyd(cfg) as d: ...`` for tests;
+    :meth:`serve_forever` for the foreground CLI."""
+
+    def __init__(self, config: VerifydConfig) -> None:
+        self.cfg = config
+        self._stats_file = None
+        sink = None
+        if config.stats_log == "-":
+            sink = sys.stderr
+        elif config.stats_log:
+            self._stats_file = open(config.stats_log, "a", encoding="utf-8")
+            sink = self._stats_file
+        self.stats = ServiceStats(sink)
+        self.cache = VerdictCache(config.cache_capacity)
+        self.queue = AdmissionQueue(
+            config.queue_depth, retry_hint=self.stats.retry_after_hint
+        )
+        self.scheduler = Scheduler(
+            self.queue,
+            self.cache,
+            self.stats,
+            time_budget_s=config.time_budget_s,
+            device=config.device,
+            unbounded_close=config.unbounded_close,
+            batch_max=config.batch_max,
+            out_dir=config.out_dir,
+            spool_dir=config.spool_dir,
+            device_rows=config.device_rows,
+            attempt_timeout_s=config.attempt_timeout_s,
+            max_restarts=config.max_restarts,
+        )
+        self._job_ids = itertools.count(1)
+        self._thread: threading.Thread | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._stop: asyncio.Future | None = None
+        self._startup_error: BaseException | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __enter__(self) -> "Verifyd":
+        self.scheduler.start(self.cfg.workers)
+        self.stats.emit(
+            "serve_start",
+            socket=self.cfg.socket_path,
+            workers=self.cfg.workers,
+            queue_depth=self.cfg.queue_depth,
+            pid=os.getpid(),
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="verifyd-accept", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError(
+                f"verifyd failed to start on {self.cfg.socket_path}"
+            )
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"verifyd failed to start on {self.cfg.socket_path}"
+            ) from self._startup_error
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.request_stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.scheduler.stop()
+        self.stats.emit("serve_stop", **self.stats.snapshot())
+        if self._stats_file is not None:
+            with contextlib.suppress(OSError):
+                self._stats_file.close()
+        with contextlib.suppress(FileNotFoundError):
+            os.remove(self.cfg.socket_path)
+
+    def request_stop(self) -> None:
+        """Thread-safe stop trigger (shutdown op, signal handler)."""
+        self._stopped.set()
+        if self._loop is not None and self._stop is not None:
+            def _finish() -> None:
+                if not self._stop.done():
+                    self._stop.set_result(None)
+
+            with contextlib.suppress(RuntimeError):
+                self._loop.call_soon_threadsafe(_finish)
+
+    def wait(self) -> None:
+        """Block until a shutdown request (or KeyboardInterrupt)."""
+        try:
+            self._stopped.wait()
+        except KeyboardInterrupt:
+            pass
+
+    def serve_forever(self) -> int:
+        with self:
+            log.info(
+                "verifyd listening on %s (queue depth %d, %d workers, "
+                "device=%s)",
+                self.cfg.socket_path,
+                self.cfg.queue_depth,
+                self.cfg.workers,
+                self.cfg.device,
+            )
+            self.wait()
+        return 0
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:
+            self._startup_error = e
+        finally:
+            self._started.set()
+            self._stopped.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = self._loop.create_future()
+        server = await asyncio.start_unix_server(
+            self._handle, path=self.cfg.socket_path
+        )
+        self._started.set()
+        try:
+            await self._stop
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    # -- connection handling ------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            while line := await reader.readline():
+                try:
+                    req = decode_frame(line)
+                except ValueError as e:
+                    resp = err(ERR_DECODE, f"malformed frame: {e}")
+                else:
+                    resp = await self._dispatch(req)
+                writer.write(encode_frame(resp))
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        try:
+            if op == "ping":
+                return ok(
+                    {
+                        "server": "verifyd",
+                        "version": _version.__version__,
+                        "protocol": PROTOCOL_VERSION,
+                        "pid": os.getpid(),
+                    }
+                )
+            if op == "stats":
+                snap = self.stats.snapshot()
+                snap["queue_depth_now"] = len(self.queue)
+                snap["cache_entries"] = len(self.cache)
+                return ok(snap)
+            if op == "shutdown":
+                self.request_stop()
+                return ok({"stopping": True})
+            if op == "submit":
+                return await self._submit(req)
+            return err(ERR_DECODE, f"unknown op {op!r}")
+        except Exception as e:  # protocol handler must never kill the loop
+            log.exception("dispatch failed for op %r", op)
+            return err(ERR_INTERNAL, repr(e))
+
+    async def _submit(self, req: dict) -> dict:
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self.stats.emit("decode_error", reason="missing history")
+            return err(ERR_DECODE, "submit needs a non-empty 'history' JSONL string")
+        client = str(req.get("client") or "anon")
+        try:
+            priority = int(req.get("priority", 10))
+        except (TypeError, ValueError):
+            return err(ERR_DECODE, f"priority must be an int, got {req.get('priority')!r}")
+        no_viz = bool(req.get("no_viz", self.cfg.no_viz))
+
+        try:
+            events = list(ev.iter_history(text))
+            hist = prepare(events, elide_trivial=True)
+        except (ev.DecodeError, ValueError) as e:
+            self.stats.emit("decode_error", client=client, reason=str(e)[:200])
+            return err(ERR_DECODE, str(e))
+
+        fingerprint = history_fingerprint(hist)
+        cached = self.cache.get(fingerprint)
+        if cached is not None:
+            self.stats.emit(
+                "cache_hit", stage="admission", client=client, fingerprint=fingerprint
+            )
+            cached.update(cached=True, queue_wait_s=0.0)
+            return ok(cached)
+
+        job = Job(
+            id=next(self._job_ids),
+            client=client,
+            priority=priority,
+            shape=shape_key(hist),
+            fingerprint=fingerprint,
+            events=events,
+            hist=hist,
+            no_viz=no_viz,
+        )
+        fut: asyncio.Future = self._loop.create_future()
+
+        def _resolve(reply: dict) -> None:
+            def _finish() -> None:
+                if not fut.done():
+                    fut.set_result(reply)
+
+            with contextlib.suppress(RuntimeError):  # loop already closed
+                self._loop.call_soon_threadsafe(_finish)
+
+        job.resolve = _resolve
+        try:
+            depth = self.queue.put(job)
+        except QueueFull as e:
+            self.stats.emit(
+                "reject",
+                client=client,
+                priority=priority,
+                depth=e.depth,
+                retry_after_s=e.retry_after_s,
+            )
+            return err(
+                ERR_QUEUE_FULL,
+                str(e),
+                retry_after_s=e.retry_after_s,
+                depth=e.depth,
+            )
+        except RuntimeError as e:  # queue closed: daemon is stopping
+            return err(ERR_SHUTTING_DOWN, str(e))
+        self.stats.emit(
+            "admit",
+            job=job.id,
+            client=client,
+            priority=priority,
+            shape=job.shape,
+            depth=depth,
+        )
+        return await fut

@@ -1,0 +1,89 @@
+"""verifyd wire protocol: newline-delimited JSON frames over a unix socket.
+
+Same framing discipline as the collector's loopback transport
+(``collector/socket_s2.py``): one JSON object per line, request → one JSON
+reply, one connection per request.  ``submit`` replies are *deferred* —
+the connection stays open until the verdict is ready (or the admission
+queue rejects the job immediately).
+
+Requests are ``{"op": <name>, ...}``; replies are ``{"ok": {...}}`` or
+``{"err": {"class": <name>, "msg": <text>, ...}}``.
+
+Ops:
+
+``ping``      → ``{"ok": {"server": "verifyd", "version", "pid", "protocol"}}``
+``stats``     → ``{"ok": {<counter snapshot>}}``
+``submit``    → history JSONL text in ``history``; optional ``client``
+                (string identity), ``priority`` (int, lower = sooner),
+                ``no_viz``.  Reply carries the ``check`` verdict
+                (``verdict`` = the CLI exit code 0/1/2, ``outcome``), the
+                HTML artifact path, the backend that decided, queue wait,
+                and ``cached`` (answered from the verdict cache).
+``shutdown``  → acks, then stops the daemon.
+
+Backpressure: a full admission queue answers ``submit`` immediately with
+``{"err": {"class": "QueueFull", "retry_after_s": <hint>}}`` — the
+documented reject-with-retry-after reply; the daemon never buffers beyond
+its configured depth.
+
+Exit-code conventions for the ``submit`` CLI: verdicts map to the
+``check`` exit codes (0 linearizable / 1 not / 2 inconclusive, 64 decode
+errors); ``EXIT_BUSY`` (75, EX_TEMPFAIL) for a backpressure reject and
+``EXIT_UNAVAILABLE`` (69, EX_UNAVAILABLE) when no daemon answers on the
+socket.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "ERR_QUEUE_FULL",
+    "ERR_DECODE",
+    "ERR_INTERNAL",
+    "ERR_SHUTTING_DOWN",
+    "EXIT_BUSY",
+    "EXIT_UNAVAILABLE",
+    "VERDICT_EXIT",
+    "encode_frame",
+    "decode_frame",
+    "ok",
+    "err",
+]
+
+PROTOCOL_VERSION = 1
+
+ERR_QUEUE_FULL = "QueueFull"
+ERR_DECODE = "DecodeError"
+ERR_INTERNAL = "InternalError"
+ERR_SHUTTING_DOWN = "ShuttingDown"
+
+#: check-CLI exit code per outcome value (cli.py docstring contract).
+VERDICT_EXIT = {"ok": 0, "illegal": 1, "unknown": 2}
+
+EXIT_BUSY = 75  # EX_TEMPFAIL: queue full, retry after the hint
+EXIT_UNAVAILABLE = 69  # EX_UNAVAILABLE: no daemon on the socket
+
+
+def encode_frame(obj: dict) -> bytes:
+    """One wire frame: compact JSON + newline (history text rides inside a
+    JSON string, so embedded newlines are escaped and framing holds)."""
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8") + b"\n"
+
+
+def decode_frame(line: bytes) -> dict:
+    obj = json.loads(line)
+    if not isinstance(obj, dict):
+        raise ValueError(f"frame must be a JSON object, got {type(obj).__name__}")
+    return obj
+
+
+def ok(payload: dict) -> dict:
+    return {"ok": payload}
+
+
+def err(cls: str, msg: str, **extra) -> dict:
+    e = {"class": cls, "msg": msg}
+    e.update(extra)
+    return {"err": e}

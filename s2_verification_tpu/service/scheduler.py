@@ -1,0 +1,276 @@
+"""Shape-grouped scheduler: the daemon's worker pool and per-job policy.
+
+Workers drain the admission queue in **shape groups** — jobs whose
+prepared histories pad to the same search shape (the encoder's bucketing
+rule, ``models/encode.py``) run back to back, so the compiled engines'
+jitted executables (and the persistent compile cache, ``utils/cache.py``)
+are reused across requests instead of recompiled per job.
+
+Per-job policy is the one-shot ``auto`` portfolio (cli.py): the CPU
+engine (native when buildable, oracle otherwise) under a time budget,
+escalating to the device search when the budget expires.  Device
+escalation runs under supervision (:mod:`.supervise`) by default — a
+wedged TPU job degrades to an unbounded CPU close for *that job* instead
+of taking the daemon down.  Unlike the one-shot CLI, an inconclusive
+budgeted job is answered UNKNOWN rather than held open unbounded unless
+``unbounded_close`` is configured: a shared daemon bounds every job, and
+the client can always rerun one-shot with ``-time-budget 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import threading
+import time
+
+from ..checker.entries import History, prepare
+from ..checker.oracle import CheckOutcome, CheckResult, check
+from ..models.encode import _bucket_chains, _bucket_len, round_pow2
+from ..models.stream import APPEND
+from .protocol import VERDICT_EXIT, err, ok
+from .queue import AdmissionQueue, Job
+from .stats import ServiceStats
+
+__all__ = ["shape_key", "Scheduler"]
+
+log = logging.getLogger("s2_verification_tpu.verifyd")
+
+
+def shape_key(hist: History) -> str:
+    """Padded-search-shape key of a prepared history: ops × chains ×
+    record-batch width, each through the encoder's bucketing rule — two
+    histories with equal keys reach compiled programs of the same shape."""
+    width = max(
+        (len(op.inp.record_hashes) for op in hist.ops if op.inp.input_type == APPEND),
+        default=1,
+    )
+    return (
+        f"{round_pow2(max(1, len(hist.ops)))}x"
+        f"{_bucket_chains(len(hist.chains))}x{_bucket_len(max(1, width))}"
+    )
+
+
+def _cpu_check(hist: History, budget: float | None) -> tuple[CheckResult, str]:
+    """Native engine when buildable, Python oracle otherwise (cli.py)."""
+    from ..checker.native import NativeUnavailable, check_native
+
+    try:
+        return check_native(hist, time_budget_s=budget), "native"
+    except NativeUnavailable as e:
+        log.debug("native checker unavailable (%s); using the Python oracle", e)
+        return check(hist, time_budget_s=budget), "oracle"
+
+
+class Scheduler:
+    def __init__(
+        self,
+        queue: AdmissionQueue,
+        cache,
+        stats: ServiceStats,
+        *,
+        time_budget_s: float | None = 10.0,
+        device: str = "supervised",  # supervised | inline | off
+        unbounded_close: bool = False,
+        batch_max: int = 16,
+        out_dir: str = "./porcupine-outputs",
+        spool_dir: str | None = None,
+        device_rows: int | None = None,
+        attempt_timeout_s: float = 900.0,
+        max_restarts: int = 2,
+    ) -> None:
+        if device not in ("supervised", "inline", "off"):
+            raise ValueError(f"unknown device escalation mode {device!r}")
+        self.queue = queue
+        self.cache = cache
+        self.stats = stats
+        self.time_budget_s = time_budget_s
+        self.device = device
+        self.unbounded_close = unbounded_close
+        self.batch_max = batch_max
+        self.out_dir = out_dir
+        self.spool_dir = spool_dir or os.path.join(
+            tempfile.gettempdir(), f"verifyd-spool-{os.getpid()}"
+        )
+        self.device_rows = device_rows
+        self.attempt_timeout_s = attempt_timeout_s
+        self.max_restarts = max_restarts
+        self._threads: list[threading.Thread] = []
+        self._stopping = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, workers: int) -> None:
+        for i in range(workers):
+            t = threading.Thread(
+                target=self._worker, name=f"verifyd-w{i}", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stopping = True
+        self.queue.close()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    # -- worker loop --------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            batch = self.queue.get_batch(self.batch_max, timeout=0.5)
+            if not batch:
+                if self.queue.closed:
+                    return
+                continue
+            for job in batch:
+                try:
+                    reply = self._run_job(job)
+                except Exception as e:  # one bad job must not kill the worker
+                    log.exception("job %d failed", job.id)
+                    reply = err("InternalError", repr(e), job=job.id)
+                job.resolve(reply)
+
+    def _run_job(self, job: Job) -> dict:
+        queue_wait = time.monotonic() - job.submitted_at
+        # Duplicate admitted while its twin was still in flight: answer
+        # from the verdict cache at execution time too.
+        cached = self.cache.get(job.fingerprint)
+        if cached is not None:
+            cached.update(cached=True, job=job.id, queue_wait_s=round(queue_wait, 4))
+            self.stats.emit(
+                "cache_hit", stage="execute", job=job.id, client=job.client
+            )
+            return ok(cached)
+
+        warm = self.stats.note_shape(job.shape)
+        self.stats.emit(
+            "start",
+            job=job.id,
+            client=job.client,
+            shape=job.shape,
+            shape_warm=warm,
+            queue_wait_s=round(queue_wait, 4),
+        )
+        t0 = time.monotonic()
+        res, backend = self._portfolio(job)
+        wall = time.monotonic() - t0
+
+        artifact = None
+        if not job.no_viz:
+            try:
+                artifact = self._write_artifact(job, res)
+            except Exception:
+                log.exception("job %d: artifact write failed", job.id)
+
+        payload = {
+            "verdict": VERDICT_EXIT[res.outcome.value],
+            "outcome": res.outcome.value,
+            "backend": backend,
+            "wall_s": round(wall, 4),
+            "ops": len(job.hist.ops),
+            "shape": job.shape,
+            "shape_warm": warm,
+            "artifact": artifact,
+            "cached": False,
+        }
+        # Inconclusive verdicts are not cached: a resubmission may get a
+        # healthier device or a bigger budget and deserves a fresh run.
+        if res.outcome != CheckOutcome.UNKNOWN:
+            self.cache.put(job.fingerprint, payload)
+        self.stats.emit(
+            "done",
+            job=job.id,
+            client=job.client,
+            backend=backend,
+            verdict=payload["verdict"],
+            wall_s=payload["wall_s"],
+            queue_wait_s=round(queue_wait, 4),
+            shape=job.shape,
+            shape_warm=warm,
+        )
+        out = dict(payload)
+        out.update(job=job.id, queue_wait_s=round(queue_wait, 4))
+        return ok(out)
+
+    # -- per-job policy -----------------------------------------------------
+
+    def _portfolio(self, job: Job) -> tuple[CheckResult, str]:
+        budget = self.time_budget_s
+        if budget is not None and budget <= 0:
+            # Budget 0 = run to completion on CPU (the reference's
+            # unbounded default), mirroring cli._run_backend.
+            res, engine = _cpu_check(job.hist, None)
+            return res, f"{engine}-unbounded"
+        budget = budget if budget is not None else 10.0
+        res, engine = _cpu_check(job.hist, budget)
+        if res.outcome != CheckOutcome.UNKNOWN:
+            return res, engine
+        if self.device != "off":
+            dres = self._escalate_device(job)
+            if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
+                return dres, f"device-{self.device}"
+            if dres is None:
+                self.stats.emit("degrade", job=job.id, to="cpu")
+        if self.unbounded_close:
+            res, engine = _cpu_check(job.hist, None)
+            return res, f"{engine}-unbounded"
+        return res, engine
+
+    def _escalate_device(self, job: Job) -> CheckResult | None:
+        log.info("job %d: CPU budget exhausted; escalating to device", job.id)
+        if self.device == "inline":
+            from ..checker.device import check_device_auto
+            from ..utils.platform import pin_platform
+
+            pin_platform()
+            kw = {} if self.device_rows is None else {"device_rows_cap": self.device_rows}
+            return check_device_auto(job.hist, **kw)
+        from .supervise import supervised_device_check
+
+        return supervised_device_check(
+            job.events,
+            spool_dir=self.spool_dir,
+            job_id=job.id,
+            attempt_timeout_s=self.attempt_timeout_s,
+            max_restarts=self.max_restarts,
+            device_rows=self.device_rows,
+            log=lambda s: log.info("job %d supervise: %s", job.id, s),
+        )
+
+    # -- artifact -----------------------------------------------------------
+
+    def _write_artifact(self, job: Job, res: CheckResult) -> str:
+        """Same artifact discipline as the one-shot CLI (cli._check_one):
+        always emit the HTML visualization, re-deriving refusal reports
+        for engines that don't produce them."""
+        if (
+            res.outcome in (CheckOutcome.ILLEGAL, CheckOutcome.UNKNOWN)
+            and not res.refusals
+        ):
+            from ..checker.diagnostics import deepest_refusals
+
+            report = deepest_refusals(job.hist, res.deepest or [])
+            if report is not None:
+                res.refusals = [report]
+
+        from ..viz import write_visualization
+
+        full = prepare(job.events, elide_trivial=False)
+        os.makedirs(self.out_dir, exist_ok=True)
+        fd, path = tempfile.mkstemp(
+            prefix=f"{job.client}-job{job.id}-", suffix=".html", dir=self.out_dir
+        )
+        os.close(fd)
+        cur = os.umask(0)
+        os.umask(cur)
+        os.chmod(path, 0o644 & ~cur)
+        write_visualization(
+            path,
+            full,
+            res,
+            title=f"s2 linearizability check — {job.client} job {job.id}",
+            checked=job.hist,
+        )
+        return path

@@ -1,0 +1,117 @@
+"""Structured per-job service events + aggregate counters.
+
+Every notable daemon event becomes one JSON line on the configured sink
+(a file-like object; ``None`` silences the stream but keeps counters):
+
+    {"ev": "done", "t": <epoch>, "job": 3, "client": "loadgen",
+     "backend": "native", "wall_s": 0.012, "queue_wait_s": 0.003,
+     "verdict": 0, "shape": "64x5x8", "shape_warm": true}
+
+Event names: ``serve_start``, ``admit``, ``reject``, ``cache_hit``,
+``start``, ``done``, ``decode_error``, ``degrade`` (supervised device job
+fell back to CPU), ``serve_stop``.  ``shape_warm`` marks a job whose
+padded search shape was already run by this daemon — the observable for
+"jitted executables reused instead of recompiled".
+
+Counters aggregate the same stream for the ``stats`` protocol op and for
+the backpressure retry-after hint (average decided-job wall time).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import IO
+
+__all__ = ["ServiceStats"]
+
+
+class ServiceStats:
+    def __init__(self, sink: IO[str] | None = None) -> None:
+        self._sink = sink
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        self._counters: dict[str, int] = {
+            "submitted": 0,
+            "admitted": 0,
+            "rejected": 0,
+            "completed": 0,
+            "cache_hits": 0,
+            "decode_errors": 0,
+            "degraded": 0,
+            "verdict_ok": 0,
+            "verdict_illegal": 0,
+            "verdict_unknown": 0,
+        }
+        self._wall_total_s = 0.0
+        self._shapes_seen: set[str] = set()
+
+    # -- event stream -------------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        with self._lock:
+            self._count(event, fields)
+            if self._sink is not None:
+                line = {"ev": event, "t": round(time.time(), 3)}
+                line.update(fields)
+                try:
+                    self._sink.write(json.dumps(line, separators=(",", ":")) + "\n")
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    # A closed/broken stats sink must never take a job down.
+                    self._sink = None
+
+    def _count(self, event: str, fields: dict) -> None:
+        if event == "admit":
+            self._counters["submitted"] += 1
+            self._counters["admitted"] += 1
+        elif event == "reject":
+            self._counters["submitted"] += 1
+            self._counters["rejected"] += 1
+        elif event == "cache_hit":
+            self._counters["submitted"] += 1
+            self._counters["cache_hits"] += 1
+        elif event == "decode_error":
+            self._counters["submitted"] += 1
+            self._counters["decode_errors"] += 1
+        elif event == "degrade":
+            self._counters["degraded"] += 1
+        elif event == "done":
+            self._counters["completed"] += 1
+            self._wall_total_s += float(fields.get("wall_s", 0.0))
+            v = {0: "verdict_ok", 1: "verdict_illegal", 2: "verdict_unknown"}.get(
+                fields.get("verdict")
+            )
+            if v is not None:
+                self._counters[v] += 1
+
+    # -- shape warmth -------------------------------------------------------
+
+    def note_shape(self, shape: str) -> bool:
+        """Record a shape about to run; returns True when this daemon has
+        already run it (compiled executables are warm)."""
+        with self._lock:
+            warm = shape in self._shapes_seen
+            self._shapes_seen.add(shape)
+            return warm
+
+    # -- aggregates ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap = dict(self._counters)
+            snap["uptime_s"] = round(time.time() - self._t0, 3)
+            snap["shapes_run"] = len(self._shapes_seen)
+            done = self._counters["completed"]
+            snap["avg_wall_s"] = round(self._wall_total_s / done, 4) if done else 0.0
+            return snap
+
+    def retry_after_hint(self, queue_depth: int) -> float:
+        """Backpressure hint: roughly how long until the queue has room —
+        depth × average decided-job wall time, clamped to [0.5, 30] s (a
+        cold daemon has no average yet; never tell a client "0")."""
+        with self._lock:
+            done = self._counters["completed"]
+            avg = (self._wall_total_s / done) if done else 1.0
+        return round(min(30.0, max(0.5, queue_depth * avg)), 2)

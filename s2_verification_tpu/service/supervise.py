@@ -1,0 +1,144 @@
+"""Supervised device escalation: bounded child + checkpoint resume.
+
+The axon TPU worker dies (not errors) on HBM exhaustion and *hangs* (not
+errors) when the tunnel drops — failure shapes that would take a resident
+daemon down with the job.  A device escalation therefore runs in the
+bounded-child/checkpoint-resume machinery the repo already trusts for
+long measurements (``checker/resilient.drive`` + ``checker/checkpoint``):
+
+- the search runs in a child with a hard attempt timeout (crash → nonzero
+  rc, hang → process-group kill);
+- each relaunch resumes from the search checkpoint, so a worker crash
+  costs one segment, not the search;
+- when the restart budget is exhausted (or the backend never answers its
+  probe again), the caller degrades *that job* to the CPU engines — the
+  daemon itself never touches the device in-process.
+
+This module is also the child's entry point
+(``python -m s2_verification_tpu.service.supervise HIST CKPT OUT``): pin
+the platform through the config API (the axon sitecustomize hook
+overrides the env var), check with the device portfolio, write the result
+JSON atomically to OUT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from ..checker.oracle import CheckOutcome, CheckResult
+
+__all__ = ["supervised_device_check"]
+
+
+def _result_to_json(res: CheckResult) -> dict:
+    return {
+        "outcome": res.outcome.value,
+        "linearization": res.linearization,
+        "deepest": list(res.deepest),
+        "steps": res.steps,
+    }
+
+
+def _result_from_json(obj: dict) -> CheckResult:
+    return CheckResult(
+        CheckOutcome(obj["outcome"]),
+        linearization=obj.get("linearization"),
+        deepest=list(obj.get("deepest") or []),
+        steps=int(obj.get("steps") or 0),
+    )
+
+
+def supervised_device_check(
+    events: list,
+    *,
+    spool_dir: str,
+    job_id: int,
+    attempt_timeout_s: float = 900.0,
+    max_restarts: int = 2,
+    device_rows: int | None = None,
+    probe: bool | None = None,
+    log=None,
+) -> CheckResult | None:
+    """Run the device search for ``events`` under supervision.
+
+    Returns the device verdict, or ``None`` when the device never produced
+    one (restart budget exhausted, backend dead) — the caller's signal to
+    degrade the job to CPU.  ``probe`` gates between-attempt backend
+    probing; default: only when the environment is not pinned to CPU
+    (probing a CPU "backend" is pointless and slow).
+    """
+    from ..checker.resilient import default_probe_cmd, drive
+    from ..utils import events as ev
+
+    os.makedirs(spool_dir, exist_ok=True)
+    hist_path = os.path.join(spool_dir, f"job{job_id}.jsonl")
+    ckpt_path = os.path.join(spool_dir, f"job{job_id}.ckpt.npz")
+    out_path = os.path.join(spool_dir, f"job{job_id}.result.json")
+    with open(hist_path, "w", encoding="utf-8") as f:
+        ev.write_history(events, f)
+
+    if probe is None:
+        probe = os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
+    cmd = [
+        sys.executable,
+        "-m",
+        "s2_verification_tpu.service.supervise",
+        hist_path,
+        ckpt_path,
+        out_path,
+    ]
+    if device_rows is not None:
+        cmd.append(str(device_rows))
+    try:
+        outcome = drive(
+            cmd,
+            done=lambda: os.path.exists(out_path),
+            attempt_timeout_s=attempt_timeout_s,
+            max_restarts=max_restarts,
+            probe_cmd=default_probe_cmd() if probe else None,
+            log=log,
+        )
+        if not outcome.ok:
+            return None
+        with open(out_path, encoding="utf-8") as f:
+            return _result_from_json(json.load(f))
+    except (OSError, ValueError, KeyError):
+        return None
+    finally:
+        for p in (hist_path, ckpt_path, out_path):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def _child_main(argv: list[str]) -> int:
+    hist_path, ckpt_path, out_path = argv[:3]
+    device_rows = int(argv[3]) if len(argv) > 3 else None
+
+    # Same pin discipline as checker/resilient._PROBE_CODE: the axon
+    # sitecustomize hook overrides JAX_PLATFORMS, so re-pin via config API.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
+    from ..checker.device import check_device_auto
+    from ..checker.entries import prepare
+    from ..utils import events as ev
+
+    hist = prepare(ev.read_history(hist_path))
+    kw = {} if device_rows is None else {"device_rows_cap": device_rows}
+    res = check_device_auto(hist, checkpoint_path=ckpt_path, **kw)
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(_result_to_json(res), f)
+    os.replace(tmp, out_path)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_child_main(sys.argv[1:]))

@@ -1,0 +1,223 @@
+"""Load-generate against verifyd: the first end-to-end serving number.
+
+Replays a directory of collected histories (``*.jsonl``) against the
+daemon from N concurrent submitter threads, honoring backpressure
+(sleep-the-hint on queue-full), and reports throughput as one JSON line
+on stdout in the bench.py metric shape:
+
+    {"metric": "service_jobs_per_sec", "value": N, "unit": "jobs/s", ...}
+
+plus latency percentiles, cache-hit and reject counts on stderr.  With
+``--socket`` pointing at a live daemon it attaches; otherwise it spawns
+an in-process daemon on a temp socket (CPU portfolio only by default —
+the serving-overhead number, not a device benchmark).
+
+Usage:
+    python scripts/service_bench.py [--histories DIR] [--socket PATH]
+        [--concurrency N] [--repeat R] [--queue-depth D] [--workers W]
+        [--time-budget S] [--no-viz] [--seed-collect]
+
+``--seed-collect`` first collects a few small histories into --histories
+when the directory is empty/missing, so the script is self-contained.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from s2_verification_tpu.service.client import (
+    VerifydBusy,
+    VerifydClient,
+    VerifydError,
+)
+
+
+def _host_cpus() -> int:
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
+def _seed_histories(out_dir: str) -> None:
+    from s2_verification_tpu.cli import main as cli_main
+
+    os.makedirs(out_dir, exist_ok=True)
+    for seed, (clients, ops, wf) in enumerate(
+        [(3, 20, "regular"), (4, 30, "match-seq-num"), (5, 25, "fencing")]
+    ):
+        rc = cli_main(
+            [
+                "collect",
+                "--num-concurrent-clients",
+                str(clients),
+                "--num-ops-per-client",
+                str(ops),
+                "--workflow",
+                wf,
+                "--seed",
+                str(seed),
+                "--out-dir",
+                out_dir,
+            ]
+        )
+        assert rc == 0, f"seed collect failed (rc={rc})"
+        time.sleep(1.05)  # records.<epoch>.jsonl names are second-granular
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--histories", default="./data")
+    ap.add_argument("--socket", default=None, help="attach to a live daemon")
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="times each history is submitted (duplicates "
+                    "exercise the verdict cache)")
+    ap.add_argument("--queue-depth", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--time-budget", type=float, default=10.0)
+    ap.add_argument("--no-viz", action="store_true", default=True)
+    ap.add_argument("--viz", dest="no_viz", action="store_false")
+    ap.add_argument("--seed-collect", action="store_true")
+    args = ap.parse_args()
+
+    paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
+    if not paths and args.seed_collect:
+        print(f"# seeding {args.histories} with collected histories", file=sys.stderr)
+        _seed_histories(args.histories)
+        paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
+    if not paths:
+        print(
+            f"# no histories under {args.histories} (use --seed-collect)",
+            file=sys.stderr,
+        )
+        return 64
+    texts = [open(p, encoding="utf-8").read() for p in paths]
+    print(f"# {len(paths)} histories x{args.repeat}, "
+          f"{args.concurrency} submitters", file=sys.stderr)
+
+    daemon_ctx = None
+    if args.socket:
+        sock = args.socket
+    else:
+        from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+
+        tmp = tempfile.mkdtemp(prefix="service-bench-")
+        sock = os.path.join(tmp, "verifyd.sock")
+        daemon_ctx = Verifyd(
+            VerifydConfig(
+                socket_path=sock,
+                queue_depth=args.queue_depth,
+                workers=args.workers,
+                time_budget_s=args.time_budget,
+                device="off",  # serving overhead, not a device benchmark
+                no_viz=args.no_viz,
+                out_dir=os.path.join(tmp, "viz"),
+                stats_log=None,
+            )
+        )
+        daemon_ctx.__enter__()
+
+    # Work list: every history x repeat, interleaved so duplicates arrive
+    # spread out (cache hits mid-stream, like real resubmission traffic).
+    work: list[tuple[int, str]] = []
+    for r in range(args.repeat):
+        for i, t in enumerate(texts):
+            work.append((i, t))
+    lock = threading.Lock()
+    cursor = [0]
+    lat: list[float] = []
+    cached_n = [0]
+    rejects = [0]
+    errors: list[str] = []
+
+    def submitter(worker_id: int) -> None:
+        client = VerifydClient(sock)
+        while True:
+            with lock:
+                if cursor[0] >= len(work):
+                    return
+                idx = cursor[0]
+                cursor[0] += 1
+            _, text = work[idx]
+            t0 = time.monotonic()
+            try:
+                while True:
+                    try:
+                        reply = client.submit(
+                            text, client=f"loadgen{worker_id}", no_viz=args.no_viz
+                        )
+                        break
+                    except VerifydBusy as e:
+                        with lock:
+                            rejects[0] += 1
+                        time.sleep(min(e.retry_after_s, 5.0))
+            except (VerifydError, OSError) as e:
+                with lock:
+                    errors.append(repr(e))
+                return
+            dt = time.monotonic() - t0
+            with lock:
+                lat.append(dt)
+                if reply.get("cached"):
+                    cached_n[0] += 1
+
+    t_start = time.monotonic()
+    threads = [
+        threading.Thread(target=submitter, args=(i,), daemon=True)
+        for i in range(args.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    try:
+        if errors:
+            print(f"# {len(errors)} submitter errors: {errors[:3]}", file=sys.stderr)
+            return 1
+        done = len(lat)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+        print(
+            f"# {done} verdicts in {wall:.2f}s; latency p50 {p50 * 1e3:.1f}ms "
+            f"p95 {p95 * 1e3:.1f}ms; {cached_n[0]} cache hits; "
+            f"{rejects[0]} backpressure rejects",
+            file=sys.stderr,
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": "service_jobs_per_sec",
+                    "value": round(done / wall, 2) if wall > 0 else 0.0,
+                    "unit": "jobs/s",
+                    "vs_baseline": 0.0,  # first serving number: no baseline yet
+                    "backend": "verifyd",
+                    "host_cpus": _host_cpus(),
+                    "cache_hits": cached_n[0],
+                    "rejects": rejects[0],
+                    "p50_ms": round(p50 * 1e3, 2),
+                    "p95_ms": round(p95 * 1e3, 2),
+                }
+            ),
+            flush=True,
+        )
+        return 0
+    finally:
+        if daemon_ctx is not None:
+            daemon_ctx.__exit__(None, None, None)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,83 @@
+"""checker.diagnostics: refusal reports at the deepest configuration."""
+
+from helpers import H, fold
+
+from s2_verification_tpu.checker.diagnostics import deepest_refusals, derive_path
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.models.stream import INIT_STATE, READ, step_set
+
+
+def _bad_read_history():
+    """Two good appends, then a read whose stream hash no serialization
+    can produce — the canonical refusing op."""
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.append_ok(1, [222], tail=2)
+    h.read_ok(2, tail=2, stream_hash=99999)
+    return prepare(h.events, elide_trivial=True)
+
+
+def test_refusing_op_set_on_known_non_linearizable():
+    hist = _bad_read_history()
+    res = check(hist)
+    assert res.outcome == CheckOutcome.ILLEGAL
+
+    report = deepest_refusals(hist, res.deepest)
+    assert report is not None
+    order, refused = report
+
+    # The deepest prefix is exactly the two appends, in program order...
+    assert sorted(order) == sorted(res.deepest)
+    appends = [op.index for op in hist.ops if op.inp.input_type != READ]
+    assert sorted(order) == sorted(appends)
+    # ...and the one op refusing to linearize there is the bogus read.
+    (read_idx,) = [op.index for op in hist.ops if op.inp.input_type == READ]
+    assert refused == [read_idx]
+
+
+def test_derive_path_reaches_deepest_configuration():
+    hist = _bad_read_history()
+    res = check(hist)
+    order, goal = derive_path(hist, res.deepest)
+    assert order is not None and goal is not None
+
+    # Replaying the derived order from INIT must be everywhere-legal and
+    # land exactly on the goal state derive_path reports.
+    states = [INIT_STATE]
+    for j in order:
+        op = next(o for o in hist.ops if o.index == j)
+        states = step_set(states, op.inp, op.out)
+        assert states, f"derived order illegal at op {j}"
+    assert any(
+        (s.tail, s.stream_hash, s.fencing_token)
+        == (goal.tail, goal.stream_hash, goal.fencing_token)
+        for s in states
+    )
+    # The configuration is the deepest one: both appends linearized.
+    assert goal.tail == 2
+    assert goal.stream_hash == fold([111, 222])
+
+
+def test_non_prefix_deepest_yields_no_report():
+    hist = _bad_read_history()
+    # Client 1's second append without its first is not a per-chain prefix.
+    appends = [op.index for op in hist.ops if op.inp.input_type != READ]
+    not_a_prefix = [max(appends)]
+    assert deepest_refusals(hist, not_a_prefix) is None
+    assert derive_path(hist, not_a_prefix) == (None, None)
+
+
+def test_empty_deepest_refuses_first_inconsistent_op():
+    # Deepest = nothing linearized: every window-open candidate is tested
+    # against INIT_STATE alone.
+    h = H()
+    h.read_ok(1, tail=7, stream_hash=12345)  # impossible from INIT
+    hist = prepare(h.events, elide_trivial=True)
+    res = check(hist)
+    assert res.outcome == CheckOutcome.ILLEGAL
+    report = deepest_refusals(hist, res.deepest or [])
+    assert report is not None
+    order, refused = report
+    assert order == []
+    assert refused == [hist.ops[0].index]

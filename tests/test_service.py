@@ -1,0 +1,390 @@
+"""verifyd acceptance tests: daemon round trip, verdict cache, backpressure.
+
+Everything runs under the session-wide ``JAX_PLATFORMS=cpu`` pin
+(conftest.py) with device escalation off — the serving layer under test
+is transport + admission + scheduling + caching, not the device search.
+"""
+
+import io
+import json
+import os
+import socket as _socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.cli import main as cli_main
+from s2_verification_tpu.service.cache import VerdictCache, history_fingerprint
+from s2_verification_tpu.service.client import (
+    VerifydBusy,
+    VerifydClient,
+    VerifydError,
+)
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.protocol import encode_frame
+from s2_verification_tpu.service.queue import AdmissionQueue, Job, QueueFull
+from s2_verification_tpu.service.scheduler import shape_key
+from s2_verification_tpu.service.stats import ServiceStats
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history() -> str:
+    """Linearizable: two clients, reads observe the folded appends."""
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    h.append_ok(2, [222, 333], tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([111, 222, 333]))
+    return _text(h)
+
+
+def bad_history() -> str:
+    """Non-linearizable: the read reports a stream hash no serialization
+    of the appends can produce."""
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=12345)
+    return _text(h)
+
+
+def _write(tmp_path, name: str, text: str) -> str:
+    p = tmp_path / name
+    p.write_text(text, encoding="utf-8")
+    return str(p)
+
+
+def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=str(tmp_path / "stats.jsonl"),
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _events(tmp_path) -> list[dict]:
+    with open(tmp_path / "stats.jsonl", encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- the acceptance round trip ----------------------------------------------
+
+
+def test_daemon_round_trip_matches_one_shot_cli(tmp_path):
+    good, bad = good_history(), bad_history()
+    good_path = _write(tmp_path, "good.jsonl", good)
+    bad_path = _write(tmp_path, "bad.jsonl", bad)
+
+    # Ground truth: the one-shot CLI's auto portfolio.
+    one_shot_good = cli_main(["check", "-file", good_path, "-no-viz"])
+    one_shot_bad = cli_main(["check", "-file", bad_path, "-no-viz"])
+    assert (one_shot_good, one_shot_bad) == (0, 1)
+
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+
+        pong = client.ping()
+        assert pong["server"] == "verifyd" and pong["protocol"] == 1
+
+        r_good = client.submit(good, client="t", no_viz=True)
+        r_bad = client.submit(bad, client="t", no_viz=True)
+        # (a) daemon verdicts match the one-shot CLI exit codes
+        assert r_good["verdict"] == one_shot_good
+        assert r_bad["verdict"] == one_shot_bad
+        assert r_good["outcome"] == "ok" and r_bad["outcome"] == "illegal"
+        assert not r_good["cached"] and not r_bad["cached"]
+
+        # (b) a duplicate is answered from the verdict cache
+        r_dup = client.submit(good, client="t", no_viz=True)
+        assert r_dup["verdict"] == one_shot_good
+        assert r_dup["cached"] is True
+
+        snap = client.stats()
+        assert snap["submitted"] == 3
+        assert snap["completed"] == 2
+        assert snap["cache_hits"] == 1
+        assert snap["cache_entries"] == 2
+
+    events = _events(tmp_path)
+    by_ev = {}
+    for e in events:
+        by_ev.setdefault(e["ev"], []).append(e)
+    # the cache hit is observable in the structured stats events
+    assert len(by_ev["cache_hit"]) == 1
+    hit = by_ev["cache_hit"][0]
+    assert hit["fingerprint"] == history_fingerprint(
+        prepare(list(ev.iter_history(good)), elide_trivial=True)
+    )
+    assert len(by_ev["done"]) == 2
+    assert {e["verdict"] for e in by_ev["done"]} == {0, 1}
+    assert by_ev["serve_stop"][0]["cache_hits"] == 1
+
+
+def test_queue_full_rejected_with_backpressure_reply(tmp_path):
+    # workers=0: nothing drains, so admission state is deterministic.
+    cfg = _daemon_cfg(tmp_path, workers=0, queue_depth=1)
+    with Verifyd(cfg) as daemon:
+        # First job occupies the queue's single slot; submitted over a raw
+        # socket whose reply we never await (no worker will resolve it).
+        holder = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+        holder.connect(cfg.socket_path)
+        holder.sendall(
+            encode_frame(
+                {"op": "submit", "history": good_history(), "client": "hog"}
+            )
+        )
+        deadline = time.monotonic() + 10
+        while len(daemon.queue) < 1:
+            assert time.monotonic() < deadline, "first job never admitted"
+            time.sleep(0.01)
+
+        # (c) the next submission is rejected immediately — a documented
+        # backpressure reply with a retry hint, not a hang.
+        client = VerifydClient(cfg.socket_path, timeout=10)
+        with pytest.raises(VerifydBusy) as ei:
+            client.submit(bad_history(), client="t")
+        assert ei.value.cls == "QueueFull"
+        assert ei.value.retry_after_s > 0
+        assert ei.value.extra["depth"] == 1
+
+        snap = client.stats()
+        assert snap["rejected"] == 1
+        holder.close()
+    events = _events(tmp_path)
+    rejects = [e for e in events if e["ev"] == "reject"]
+    assert len(rejects) == 1 and rejects[0]["retry_after_s"] > 0
+
+
+def test_submit_decode_error_and_artifact(tmp_path):
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        with pytest.raises(VerifydError) as ei:
+            client.submit('{"not": "an event"}\n', client="t")
+        assert ei.value.cls == "DecodeError"
+
+        # default (no no_viz) writes the HTML artifact like one-shot check
+        reply = client.submit(bad_history(), client="t")
+        assert reply["artifact"] and os.path.exists(reply["artifact"])
+        assert reply["artifact"].endswith(".html")
+        assert os.path.dirname(reply["artifact"]) == str(tmp_path / "viz")
+
+
+def test_in_flight_duplicate_answered_from_cache_at_execution(tmp_path):
+    # Two identical jobs admitted before any worker runs: the second must
+    # be answered by the execution-time cache check, not re-searched.
+    cfg = _daemon_cfg(tmp_path, workers=0, queue_depth=8)
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        socks = []
+        for _ in range(2):
+            s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+            s.connect(cfg.socket_path)
+            s.sendall(
+                encode_frame(
+                    {
+                        "op": "submit",
+                        "history": good_history(),
+                        "client": "dup",
+                        "no_viz": True,
+                    }
+                )
+            )
+            socks.append(s)
+        deadline = time.monotonic() + 10
+        while len(daemon.queue) < 2:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        daemon.scheduler.start(1)  # now let one worker drain both
+        replies = []
+        for s in socks:
+            buf = b""
+            s.settimeout(120)
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(1 << 16)
+                assert chunk, "daemon closed mid-reply"
+                buf += chunk
+            replies.append(json.loads(buf)["ok"])
+            s.close()
+        assert [r["verdict"] for r in replies] == [0, 0]
+        assert sorted(r["cached"] for r in replies) == [False, True]
+    hits = [e for e in _events(tmp_path) if e["ev"] == "cache_hit"]
+    assert len(hits) == 1 and hits[0]["stage"] == "execute"
+
+
+# -- CLI subcommands ---------------------------------------------------------
+
+
+def test_serve_submit_cli_round_trip(tmp_path):
+    sock = str(tmp_path / "verifyd.sock")
+    good_path = _write(tmp_path, "good.jsonl", good_history())
+    bad_path = _write(tmp_path, "bad.jsonl", bad_history())
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo_root)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "s2_verification_tpu",
+            "serve",
+            "-socket",
+            sock,
+            "--device",
+            "off",
+            "-out-dir",
+            str(tmp_path / "viz"),
+            "--stats-log",
+            str(tmp_path / "stats.jsonl"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=str(tmp_path),
+    )
+    try:
+        deadline = time.monotonic() + 120
+        while not os.path.exists(sock):
+            assert proc.poll() is None, "serve exited early"
+            assert time.monotonic() < deadline, "daemon socket never appeared"
+            time.sleep(0.1)
+
+        assert (
+            cli_main(["submit", "-file", good_path, "-socket", sock, "-no-viz"])
+            == 0
+        )
+        assert (
+            cli_main(["submit", "-file", bad_path, "-socket", sock, "-no-viz"])
+            == 1
+        )
+        # duplicate rides the verdict cache; -stats exposes it on stdout
+        import contextlib
+
+        cap = io.StringIO()
+        with contextlib.redirect_stdout(cap):
+            rc = cli_main(
+                ["submit", "-file", good_path, "-socket", sock, "-no-viz", "-stats"]
+            )
+        assert rc == 0
+        line = json.loads(cap.getvalue().strip())
+        assert line["cached"] is True and line["outcome"] == "ok"
+
+        # malformed history → usage exit from the daemon's decode reply
+        junk = _write(tmp_path, "junk.jsonl", "{broken\n")
+        assert cli_main(["submit", "-file", junk, "-socket", sock]) == 64
+
+        VerifydClient(sock).shutdown()
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_submit_without_daemon_is_unavailable(tmp_path):
+    good_path = _write(tmp_path, "good.jsonl", good_history())
+    rc = cli_main(
+        ["submit", "-file", good_path, "-socket", str(tmp_path / "nope.sock")]
+    )
+    assert rc == 69  # EX_UNAVAILABLE
+
+
+def test_serve_refuses_stale_socket(tmp_path):
+    stale = tmp_path / "stale.sock"
+    stale.write_text("")
+    assert cli_main(["serve", "-socket", str(stale)]) == 64
+
+
+# -- unit coverage: queue, cache, shapes ------------------------------------
+
+
+def _job(i, priority=10, shape="4x2x1"):
+    return Job(
+        id=i,
+        client="u",
+        priority=priority,
+        shape=shape,
+        fingerprint=f"v1:{i:016x}:1",
+        events=[],
+        hist=None,
+    )
+
+
+def test_admission_queue_priority_and_shape_grouping():
+    q = AdmissionQueue(depth=8, retry_hint=lambda d: 1.0)
+    q.put(_job(1, priority=10, shape="A"))
+    q.put(_job(2, priority=1, shape="B"))
+    q.put(_job(3, priority=5, shape="B"))
+    q.put(_job(4, priority=7, shape="A"))
+    # Best-priority job leads; its shape-mates ride along in priority order.
+    batch = q.get_batch(16, timeout=1)
+    assert [j.id for j in batch] == [2, 3]
+    batch = q.get_batch(16, timeout=1)
+    assert [j.id for j in batch] == [4, 1]
+
+
+def test_admission_queue_rejects_at_depth():
+    q = AdmissionQueue(depth=2, retry_hint=lambda d: 2.5)
+    q.put(_job(1))
+    q.put(_job(2))
+    with pytest.raises(QueueFull) as ei:
+        q.put(_job(3))
+    assert ei.value.depth == 2 and ei.value.retry_after_s == 2.5
+    assert len(q) == 2  # reject means reject: nothing buffered past the bound
+
+
+def test_fingerprint_stable_and_discriminating():
+    g1 = prepare(list(ev.iter_history(good_history())), elide_trivial=True)
+    g2 = prepare(list(ev.iter_history(good_history())), elide_trivial=True)
+    b = prepare(list(ev.iter_history(bad_history())), elide_trivial=True)
+    assert history_fingerprint(g1) == history_fingerprint(g2)
+    assert history_fingerprint(g1) != history_fingerprint(b)
+    assert history_fingerprint(g1).startswith("v1:")
+
+
+def test_verdict_cache_lru_and_isolation():
+    c = VerdictCache(capacity=2)
+    c.put("a", {"verdict": 0})
+    c.put("b", {"verdict": 1})
+    got = c.get("a")
+    got["verdict"] = 99  # caller mutation must not poison the cache
+    assert c.get("a")["verdict"] == 0
+    c.put("c", {"verdict": 2})  # evicts b (a was refreshed by the gets)
+    assert c.get("b") is None and c.get("a") is not None
+
+
+def test_shape_key_buckets_pad_like_the_encoder():
+    small = prepare(list(ev.iter_history(good_history())), elide_trivial=True)
+    assert shape_key(small) == "4x2x2"
+    # same key for a same-bucket sibling: reuse of compiled executables
+    h = H()
+    h.append_ok(1, [5, 6], tail=2)
+    h.read_ok(2, tail=2, stream_hash=fold([5, 6]))
+    h.append_ok(2, [7], tail=3)
+    sib = prepare(list(ev.iter_history(_text(h))), elide_trivial=True)
+    assert shape_key(sib) == shape_key(small)
+
+
+def test_stats_retry_hint_is_clamped():
+    s = ServiceStats(None)
+    assert s.retry_after_hint(0) == 0.5  # empty queue: floor, never "0"
+    assert s.retry_after_hint(4) == 4.0  # cold daemon assumes 1s/job
+    s.emit("done", wall_s=20.0, verdict=0)
+    assert s.retry_after_hint(100) == 30.0  # depth x avg, ceiling
