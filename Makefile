@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full clean
 
 all: native
 
@@ -29,6 +29,16 @@ t1:
 
 bench:
 	$(PYTHON) bench.py
+
+# Durability/transport chaos harness (scripts/chaos_bench.py): fault
+# proxy + auth probes + SIGKILL crash recovery.  `chaos` is the short
+# smoke; `chaos-full` runs the whole fault matrix (the slow-marked
+# pytest path runs the smoke too: tests/test_chaos.py).
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
+
+chaos-full:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 clean:
 	$(MAKE) -C native clean

@@ -10,12 +10,19 @@
               environment has no network): writes
               ``./data/records.<epoch>.jsonl`` and prints the path.
 ``serve``   — run ``verifyd``, the resident batched verification daemon
-              (service/), on a unix socket: admission queue with explicit
-              backpressure, shape-grouped scheduling (compiles amortize
-              across requests), verdict cache, supervised device jobs.
-``submit``  — send one history to a running ``verifyd`` and exit with the
-              ``check`` exit code for its verdict (75 = queue full after
-              retries, 69 = no daemon on the socket).
+              (service/), on a unix socket and optionally an authenticated
+              TCP listener (``--tcp`` + shared secret): admission queue
+              with explicit backpressure, shape-grouped scheduling
+              (compiles amortize across requests), verdict cache,
+              supervised device jobs.  ``--state-dir`` makes the verdict
+              cache and the admission queue crash-safe (CRC-checked
+              segment logs; a restarted daemon answers decided
+              fingerprints warm and re-runs orphaned accepted jobs).
+``submit``  — send one history to a running ``verifyd`` (unix socket path
+              or ``host:port``) and exit with the ``check`` exit code for
+              its verdict (75 = queue full after retries, 69 = no daemon
+              ever answered, 76 = a daemon was reached but refused after
+              retries — bad secret, persistent frame errors).
 
 Backends for ``check``:
 
@@ -378,6 +385,20 @@ def _cmd_collect(args: argparse.Namespace) -> int:
     return 0
 
 
+def _read_secret(args: argparse.Namespace) -> bytes | None:
+    """Shared secret for the TCP transport: ``--secret-file`` wins, then
+    the ``VERIFYD_SECRET`` environment variable (never a CLI argument —
+    process listings leak those)."""
+    if getattr(args, "secret_file", None):
+        with open(args.secret_file, "rb") as f:
+            secret = f.read().strip()
+        if not secret:
+            raise SystemExit(f"secret file {args.secret_file} is empty")
+        return secret
+    env = os.environ.get("VERIFYD_SECRET", "")
+    return env.encode("utf-8") if env else None
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     from .service.daemon import Verifyd, VerifydConfig
 
@@ -388,6 +409,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             "%s already exists — another verifyd running? (remove the file "
             "if it is stale)",
             args.socket,
+        )
+        return USAGE_EXIT
+    secret = _read_secret(args)
+    if args.tcp and not secret:
+        log.error(
+            "--tcp requires a shared secret (--secret-file or VERIFYD_SECRET)"
         )
         return USAGE_EXIT
     cfg = VerifydConfig(
@@ -401,6 +428,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         no_viz=args.no_viz,
         stats_log=args.stats_log or None,
         device_rows=args.device_rows,
+        tcp=args.tcp or None,
+        secret=secret,
+        state_dir=args.state_dir or None,
+        fsync=args.fsync,
     )
     daemon = Verifyd(cfg)
 
@@ -416,8 +447,14 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
 
 def _cmd_submit(args: argparse.Namespace) -> int:
-    from .service.client import VerifydBusy, VerifydClient, VerifydError
-    from .service.protocol import EXIT_BUSY, EXIT_UNAVAILABLE
+    from .service.client import (
+        VerifydBusy,
+        VerifydClient,
+        VerifydError,
+        VerifydRefused,
+        VerifydUnavailable,
+    )
+    from .service.protocol import EXIT_BUSY, EXIT_PROTOCOL, EXIT_UNAVAILABLE
 
     if args.file == "-":
         text = sys.stdin.read()
@@ -428,7 +465,11 @@ def _cmd_submit(args: argparse.Namespace) -> int:
         except OSError as e:
             log.error("failed to read history: %s", e)
             return USAGE_EXIT
-    client = VerifydClient(args.socket)
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
     try:
         reply = client.submit_with_retry(
             text,
@@ -437,6 +478,7 @@ def _cmd_submit(args: argparse.Namespace) -> int:
             no_viz=args.no_viz or None,
             timeout=args.timeout,
             retries=args.retries,
+            backoff_s=args.backoff,
         )
     except VerifydBusy as e:
         log.error(
@@ -445,12 +487,20 @@ def _cmd_submit(args: argparse.Namespace) -> int:
             e.retry_after_s,
         )
         return EXIT_BUSY
+    except VerifydUnavailable as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydRefused as e:
+        log.error("verifyd on %s refused: %s", args.socket, e)
+        return EXIT_PROTOCOL
     except VerifydError as e:
         if e.cls == "DecodeError":
             log.error("daemon rejected the history: %s", e.msg)
             return USAGE_EXIT
+        # The daemon answered — an internal failure is a refusal, not
+        # unavailability (exit 76, not 69).
         log.error("submit failed: %s", e)
-        return EXIT_UNAVAILABLE
+        return EXIT_PROTOCOL
     except (OSError, TimeoutError) as e:
         log.error("cannot reach verifyd on %s: %s", args.socket, e)
         return EXIT_UNAVAILABLE
@@ -644,6 +694,35 @@ def build_parser() -> argparse.ArgumentParser:
         default=None,
         help="device-resident frontier cap for escalated jobs",
     )
+    s.add_argument(
+        "--tcp",
+        default=None,
+        metavar="HOST:PORT",
+        help="also listen on an authenticated TCP address (port 0 = "
+        "ephemeral); every frame carries an HMAC under the shared secret "
+        "(--secret-file / VERIFYD_SECRET) and unauthenticated frames are "
+        "rejected before admission",
+    )
+    s.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    s.add_argument(
+        "--state-dir",
+        default=None,
+        help="durable-state directory (verdict-cache segments + admission "
+        "journal): a restarted daemon answers previously decided "
+        "histories from disk and re-runs jobs that were accepted but "
+        "never answered (default: in-memory only)",
+    )
+    s.add_argument(
+        "--fsync",
+        action="store_true",
+        help="fsync every durable append (survives machine crashes, not "
+        "just daemon death; slower)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
 
     u = sub.add_parser("submit", help="submit one history to a running verifyd")
@@ -651,7 +730,18 @@ def build_parser() -> argparse.ArgumentParser:
         "-file", "--file", required=True, help="history JSONL path, '-' for stdin"
     )
     u.add_argument(
-        "-socket", "--socket", required=True, help="the daemon's socket path"
+        "-socket",
+        "--socket",
+        required=True,
+        help="the daemon's unix-socket path, or HOST:PORT for the "
+        "authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET)",
+    )
+    u.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
     )
     u.add_argument("--client", default="cli", help="client identity for the queue")
     u.add_argument(
@@ -670,9 +760,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--retries",
         type=int,
         default=0,
-        help="re-submissions after a queue-full reject, sleeping the "
-        "daemon's retry-after hint between attempts (default 0: fail "
-        "fast with exit 75)",
+        help="re-submissions after a transient failure.  Queue-full "
+        "rejects sleep the daemon's retry-after hint; connect failures "
+        "and transport noise sleep exponential backoff with jitter "
+        "(--backoff).  Default 0: fail fast.  Exhausted retries exit "
+        "75 (still busy), 69 (no daemon ever answered), or 76 (a "
+        "daemon was reached but refused)",
+    )
+    u.add_argument(
+        "--backoff",
+        type=float,
+        default=0.5,
+        metavar="SECONDS",
+        help="base of the exponential retry backoff: attempt n sleeps "
+        "uniform(0, SECONDS * 2^n), capped at 30s (default 0.5)",
     )
     u.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"

@@ -18,6 +18,19 @@ amortizes all four across requests.  Four cooperating pieces:
                       one wedged TPU job degrades to CPU instead of taking
                       the daemon down.
 
+Durability and remote access ride three more:
+
+- :mod:`.journal`    — write-ahead journal of accepted jobs over the
+                       CRC-checked segment log (``utils/seglog.py``); a
+                       restarted daemon re-runs accepted-but-unanswered
+                       jobs instead of silently dropping them.
+- :mod:`.protocol`   — adds HMAC frame auth for the TCP transport,
+                       bounded frame sizes, and the 69/75/76 exit-code
+                       contract.
+- :mod:`.chaosproxy` — fault-injecting frame proxy (truncate / garble /
+                       delay / duplicate) backing ``scripts/chaos_bench.py``
+                       and ``make chaos``.
+
 :mod:`.daemon` ties them together behind a unix-domain socket speaking the
 same newline-delimited-JSON framing discipline as ``collector/socket_s2.py``;
 :mod:`.client` is the submit side; :mod:`.stats` emits per-job structured

@@ -12,17 +12,34 @@ histories differing only in definite failures share the cached answer.
 The cached value is the full reply payload (verdict, outcome, backend,
 artifact path), so a hit costs one dict lookup — no backend, no compile,
 no search.
+
+With ``persist_dir`` set, every put also appends one record to a
+CRC-checked segment log (``utils/seglog.py``) — the same
+durable-artifact discipline as the persistent compile cache
+(``utils/cache.py``), but for verdicts: a restarted daemon replays the
+segments at startup and answers previously decided fingerprints without
+invoking a checker.  Torn final records and corrupted segments recover
+to a valid prefix (a lost verdict costs a re-search, never a wrong
+answer).  Disk is bounded by segment rotation (oldest verdicts age out
+with their segment — it is a cache on disk too).  Cached artifact paths
+may dangle after a restart; the verdict fields are what durability is
+for.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import threading
 from collections import OrderedDict
 
 from ..checker.entries import History
 from ..utils.hashing import chain_hash, record_hash
+from ..utils.seglog import Recovery, SegmentLog
 
 __all__ = ["history_fingerprint", "VerdictCache"]
+
+log = logging.getLogger("s2_verification_tpu.verifyd")
 
 _FP_VERSION = "v1"
 
@@ -49,14 +66,42 @@ def history_fingerprint(hist: History) -> str:
 
 
 class VerdictCache:
-    """Thread-safe LRU of fingerprint → reply payload."""
+    """Thread-safe LRU of fingerprint → reply payload, optionally spilled
+    to an append-only segment log so restarts answer duplicates warm."""
 
-    def __init__(self, capacity: int = 4096) -> None:
+    def __init__(
+        self,
+        capacity: int = 4096,
+        persist_dir: str | None = None,
+        *,
+        fsync: bool = False,
+        max_segments: int = 8,
+    ) -> None:
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, dict] = OrderedDict()
+        self._log: SegmentLog | None = None
+        self.loaded = 0  #: entries replayed from disk at construction
+        self.recovery: Recovery | None = None
+        if persist_dir is not None:
+            self._log = SegmentLog(
+                persist_dir, fsync=fsync, max_segments=max_segments
+            )
+            for payload in self._log.replay():
+                try:
+                    rec = json.loads(payload)
+                    fp, value = rec["fp"], rec["p"]
+                except (ValueError, KeyError, TypeError):
+                    continue  # CRC-intact but foreign: skip, never crash
+                if isinstance(fp, str) and isinstance(value, dict):
+                    self._entries[fp] = value
+                    self._entries.move_to_end(fp)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+            self.loaded = len(self._entries)
+            self.recovery = self._log.recovery
 
     def __len__(self) -> int:
         with self._lock:
@@ -76,3 +121,19 @@ class VerdictCache:
             self._entries.move_to_end(fingerprint)
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+            if self._log is not None:
+                try:
+                    self._log.append(
+                        json.dumps(
+                            {"fp": fingerprint, "p": payload},
+                            separators=(",", ":"),
+                        ).encode("utf-8")
+                    )
+                except (OSError, ValueError):
+                    # Spill is best-effort: a full disk must not fail jobs.
+                    log.exception("verdict-cache spill failed; disabling")
+                    self._log = None
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()

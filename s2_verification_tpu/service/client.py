@@ -5,17 +5,56 @@ connection discipline as the collector transport's setup path
 (``collector/socket_s2.py:snapshot_bodies``).  ``submit`` keeps its
 connection open until the daemon replies with the verdict; everything
 else answers immediately.
+
+The address selects the transport: a filesystem path dials the unix
+socket unchanged; ``host:port`` (with a ``secret``) dials the
+authenticated TCP listener, signing every request frame and verifying
+every reply with the protocol's HMAC (:func:`.protocol.sign_frame`).
+
+Failures divide into two classes the retry loop treats differently:
+
+* :class:`VerifydUnavailable` — no daemon ever *answered* (connect
+  refused/timed out).  Retried with exponential backoff + jitter; if it
+  never clears, the CLI exits 69 (EX_UNAVAILABLE).
+* :class:`VerifydRefused` — a daemon was reached but the exchange failed
+  at the transport layer (connection lost mid-call, garbled/unsigned
+  reply, ``FrameError``/``FrameTooLarge``/``AuthError`` replies).
+  Transient flavors (lost connection, frame noise) are retried the same
+  way; a refusal that persists exits 76 (EX_PROTOCOL) — *distinct* from
+  69, because "something is there and saying no" needs a different fix
+  than "nothing is listening".
+
+Backpressure (``QueueFull`` → :class:`VerifydBusy`) keeps its own loop:
+the daemon's ``retry_after_s`` hint takes precedence over the backoff
+schedule.  Semantic errors (``DecodeError``: the *history* is bad) are
+never retried — resubmitting the same bytes cannot help.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import socket
 import time
 
-from .protocol import ERR_QUEUE_FULL, encode_frame
+from .protocol import (
+    ERR_AUTH,
+    ERR_FRAME,
+    ERR_QUEUE_FULL,
+    ERR_TOO_LARGE,
+    encode_frame,
+    parse_hostport,
+    sign_frame,
+    verify_frame,
+)
 
-__all__ = ["VerifydError", "VerifydBusy", "VerifydClient"]
+__all__ = [
+    "VerifydError",
+    "VerifydBusy",
+    "VerifydUnavailable",
+    "VerifydRefused",
+    "VerifydClient",
+]
 
 
 class VerifydError(RuntimeError):
@@ -36,32 +75,130 @@ class VerifydBusy(VerifydError):
         return float(self.extra.get("retry_after_s", 1.0))
 
 
+class VerifydUnavailable(VerifydError):
+    """No daemon ever answered a connect (CLI exit 69, EX_UNAVAILABLE)."""
+
+
+class VerifydRefused(VerifydError):
+    """A daemon was reached but refused or broke the exchange (CLI exit
+    76, EX_PROTOCOL after retries).  ``transient`` marks flavors worth
+    retrying (lost connection, line noise) vs. definite refusals (bad
+    auth secret: every retry will fail identically)."""
+
+    def __init__(
+        self,
+        cls: str,
+        msg: str,
+        extra: dict | None = None,
+        *,
+        transient: bool = True,
+    ) -> None:
+        super().__init__(cls, msg, extra)
+        self.transient = transient
+
+
+#: error-frame classes that are transport noise, not semantic failures
+_REFUSAL_CLASSES = {ERR_FRAME, ERR_TOO_LARGE, ERR_AUTH}
+
+
 class VerifydClient:
-    def __init__(self, path: str, timeout: float | None = None) -> None:
-        self.path = path
+    def __init__(
+        self,
+        address: str,
+        timeout: float | None = None,
+        *,
+        secret: bytes | None = None,
+    ) -> None:
+        #: unix-socket path, or ``host:port`` for the TCP transport
+        self.address = address
         #: default per-call timeout; submit calls may override (a verdict
         #: on a hard history legitimately takes longer than a ping)
         self.timeout = timeout
+        self.secret = secret
+        self._hostport: tuple[str, int] | None = None
+        if not address.startswith(("/", ".")) and ":" in address:
+            self._hostport = parse_hostport(address)
+        if self._hostport is not None and secret is None:
+            raise ValueError("the TCP transport requires a shared secret")
+
+    # retained name: tests and the CLI historically read .path
+    @property
+    def path(self) -> str:
+        return self.address
+
+    def _connect(self, timeout: float | None) -> socket.socket:
+        try:
+            if self._hostport is not None:
+                return socket.create_connection(self._hostport, timeout=timeout)
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(timeout)
+            s.connect(self.address)
+            return s
+        except (OSError, socket.timeout) as e:
+            raise VerifydUnavailable(
+                "Unavailable", f"cannot connect to {self.address}: {e}"
+            ) from e
 
     def _call(self, req: dict, timeout: float | None = None) -> dict:
-        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
-            s.settimeout(timeout if timeout is not None else self.timeout)
-            s.connect(self.path)
-            s.sendall(encode_frame(req))
-            buf = b""
-            while not buf.endswith(b"\n"):
-                chunk = s.recv(1 << 16)
-                if not chunk:
-                    raise VerifydError(
-                        "ConnectionClosed", "daemon closed the connection mid-call"
+        if self.secret is not None and self._hostport is not None:
+            req = sign_frame(req, self.secret)
+        tmo = timeout if timeout is not None else self.timeout
+        with self._connect(tmo) as s:
+            try:
+                s.sendall(encode_frame(req))
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = s.recv(1 << 16)
+                    if not chunk:
+                        raise VerifydRefused(
+                            "ConnectionClosed",
+                            "daemon closed the connection mid-call",
+                        )
+                    buf += chunk
+                # One frame per line: anything past the first newline is
+                # a stray duplicate reply, not part of this frame.
+                buf = buf.split(b"\n", 1)[0]
+            except (OSError, socket.timeout) as e:
+                # Connected, then the exchange died: the daemon exists.
+                raise VerifydRefused(
+                    "ConnectionLost", f"exchange with {self.address} failed: {e}"
+                ) from e
+        try:
+            resp = json.loads(buf)
+        except ValueError as e:
+            raise VerifydRefused("GarbledReply", f"reply is not JSON: {e}") from e
+        if not isinstance(resp, dict):
+            raise VerifydRefused("GarbledReply", "reply frame is not an object")
+        if self.secret is not None and self._hostport is not None:
+            if not verify_frame(resp, self.secret):
+                # A reply we can't verify that *claims* AuthError means the
+                # secrets disagree (the daemon signs with its own) — that's
+                # the actionable diagnosis, and equally non-transient.
+                if (
+                    isinstance(resp.get("err"), dict)
+                    and resp["err"].get("class") == ERR_AUTH
+                ):
+                    e = resp["err"]
+                    raise VerifydRefused(
+                        ERR_AUTH, e.get("msg", ""), e, transient=False
                     )
-                buf += chunk
-        resp = json.loads(buf)
+                raise VerifydRefused(
+                    "ReplyAuth",
+                    "daemon reply failed HMAC verification",
+                    transient=False,
+                )
         if "err" in resp:
             e = resp["err"]
             cls = e.get("class", "InternalError")
-            exc = VerifydBusy if cls == ERR_QUEUE_FULL else VerifydError
-            raise exc(cls, e.get("msg", ""), e)
+            if cls == ERR_QUEUE_FULL:
+                raise VerifydBusy(cls, e.get("msg", ""), e)
+            if cls in _REFUSAL_CLASSES:
+                # Auth rejection is definite: the secret is wrong and
+                # stays wrong.  Frame noise is worth another try.
+                raise VerifydRefused(
+                    cls, e.get("msg", ""), e, transient=cls != ERR_AUTH
+                )
+            raise VerifydError(cls, e.get("msg", ""), e)
         return resp["ok"]
 
     # -- ops ----------------------------------------------------------------
@@ -99,12 +236,25 @@ class VerifydClient:
         history_text: str,
         *,
         retries: int = 0,
+        backoff_s: float = 0.5,
         max_retry_wait_s: float = 30.0,
+        rng: random.Random | None = None,
         **kw,
     ) -> dict:
-        """``submit``, honoring backpressure: sleep the daemon's
-        retry-after hint (capped) between attempts, up to ``retries``
-        re-submissions; the final :class:`VerifydBusy` propagates."""
+        """``submit`` with the full retry policy.
+
+        Backpressure sleeps the daemon's ``retry_after_s`` hint (the hint
+        wins over the schedule — the daemon knows its own drain rate).
+        Transient transport failures (:class:`VerifydUnavailable`,
+        transient :class:`VerifydRefused`) sleep exponential backoff with
+        full jitter: ``uniform(0, backoff_s * 2**attempt)``, capped at
+        ``max_retry_wait_s``.  Non-transient refusals (wrong secret) and
+        semantic errors (``DecodeError``) raise immediately — retrying
+        identical bytes cannot change those answers.  After ``retries``
+        re-submissions the last error propagates for the CLI's exit-code
+        mapping (75 busy / 69 unavailable / 76 refused).
+        """
+        rng = rng or random.Random()
         for attempt in range(retries + 1):
             try:
                 return self.submit(history_text, **kw)
@@ -112,4 +262,12 @@ class VerifydClient:
                 if attempt == retries:
                     raise
                 time.sleep(min(e.retry_after_s, max_retry_wait_s))
+            except (VerifydUnavailable, VerifydRefused) as e:
+                if isinstance(e, VerifydRefused) and not e.transient:
+                    raise
+                if attempt == retries:
+                    raise
+                time.sleep(
+                    min(max_retry_wait_s, rng.uniform(0, backoff_s * (2**attempt)))
+                )
         raise AssertionError("unreachable")

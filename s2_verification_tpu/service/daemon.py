@@ -1,20 +1,32 @@
 """verifyd: the resident verification daemon.
 
-Serves the :mod:`.protocol` over a unix-domain socket.  Like the
-collector's loopback S2 server (``collector/socket_s2.py``), the asyncio
-acceptor runs a private event loop on a daemon thread, so the daemon
-composes as a context manager in tests and as a foreground process under
+Serves the :mod:`.protocol` over a unix-domain socket and, optionally, an
+HMAC-authenticated TCP listener (``VerifydConfig.tcp`` + ``secret``) so
+collectors on other machines can submit.  Like the collector's loopback
+S2 server (``collector/socket_s2.py``), the asyncio acceptor runs a
+private event loop on a daemon thread, so the daemon composes as a
+context manager in tests and as a foreground process under
 ``s2-verification-tpu serve``.  Checking itself never runs on the event
 loop: the acceptor only decodes, consults the verdict cache, and admits
 into the bounded queue; :class:`~.scheduler.Scheduler` worker threads do
 the searching and resolve each submit's deferred reply through
 ``call_soon_threadsafe``.
+
+Durability (``VerifydConfig.state_dir``): the verdict cache spills to
+CRC-checked segment files (``<state_dir>/verdicts/``) and admission
+write-ahead records to a journal (``<state_dir>/journal/``).  Startup
+replays both — previously decided fingerprints answer warm without a
+checker, and accepted-but-unanswered jobs from a crashed run are
+re-admitted (``orphan`` stats events) instead of silently dropped.  This
+is the crash→bounded-child→resume discipline ``checker/resilient.py``
+applies to the TPU worker, extended to the daemon's own state.
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
+import functools
 import itertools
 import logging
 import os
@@ -26,16 +38,24 @@ from .. import version as _version
 from ..checker.entries import prepare
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
+from .journal import JobJournal
 from .protocol import (
+    ERR_AUTH,
     ERR_DECODE,
+    ERR_FRAME,
     ERR_INTERNAL,
     ERR_QUEUE_FULL,
     ERR_SHUTTING_DOWN,
+    ERR_TOO_LARGE,
+    MAX_FRAME_BYTES,
     PROTOCOL_VERSION,
     decode_frame,
     encode_frame,
     err,
     ok,
+    parse_hostport,
+    sign_frame,
+    verify_frame,
 )
 from .queue import AdmissionQueue, Job, QueueFull
 from .scheduler import Scheduler, shape_key
@@ -64,6 +84,22 @@ class VerifydConfig:
     max_restarts: int = 2
     #: structured-events sink: a path, "-" for stderr, or None (silent)
     stats_log: str | None = None
+    #: "host:port" for the authenticated TCP listener (port 0 = ephemeral,
+    #: bound port on :attr:`Verifyd.tcp_port`); requires ``secret``
+    tcp: str | None = None
+    #: shared secret for TCP frame HMACs; the unix socket never needs it
+    secret: bytes | None = None
+    #: per-frame read bound; oversized frames get a definite FrameTooLarge
+    frame_max_bytes: int = MAX_FRAME_BYTES
+    #: TCP per-frame *read* deadline (slowloris bound) — the deferred
+    #: submit reply is bounded by the scheduler's budgets, not this
+    conn_deadline_s: float = 30.0
+    #: durable-state root (verdict segments + admission journal); None =
+    #: in-memory only, the pre-durability behavior
+    state_dir: str | None = None
+    #: fsync every durable append (survives machine crash, not just
+    #: process death); off by default — SIGKILL safety needs only flush
+    fsync: bool = False
     extra: dict = field(default_factory=dict)
 
 
@@ -73,6 +109,10 @@ class Verifyd:
 
     def __init__(self, config: VerifydConfig) -> None:
         self.cfg = config
+        if config.tcp is not None and not config.secret:
+            raise ValueError(
+                "a TCP listener requires a shared secret (VerifydConfig.secret)"
+            )
         self._stats_file = None
         sink = None
         if config.stats_log == "-":
@@ -81,7 +121,26 @@ class Verifyd:
             self._stats_file = open(config.stats_log, "a", encoding="utf-8")
             sink = self._stats_file
         self.stats = ServiceStats(sink)
-        self.cache = VerdictCache(config.cache_capacity)
+        verdict_dir = (
+            os.path.join(config.state_dir, "verdicts") if config.state_dir else None
+        )
+        self.cache = VerdictCache(
+            config.cache_capacity, verdict_dir, fsync=config.fsync
+        )
+        if verdict_dir is not None:
+            rec = self.cache.recovery
+            self.stats.emit(
+                "cache_loaded",
+                entries=self.cache.loaded,
+                segments=rec.segments if rec else 0,
+                torn_tail_bytes=rec.torn_tail_bytes if rec else 0,
+                bad_segments=rec.bad_segments if rec else 0,
+            )
+        self.journal = (
+            JobJournal(os.path.join(config.state_dir, "journal"), fsync=config.fsync)
+            if config.state_dir
+            else None
+        )
         self.queue = AdmissionQueue(
             config.queue_depth, retry_hint=self.stats.retry_after_hint
         )
@@ -98,6 +157,7 @@ class Verifyd:
             device_rows=config.device_rows,
             attempt_timeout_s=config.attempt_timeout_s,
             max_restarts=config.max_restarts,
+            journal=self.journal,
         )
         self._job_ids = itertools.count(1)
         self._thread: threading.Thread | None = None
@@ -106,14 +166,18 @@ class Verifyd:
         self._stopped = threading.Event()
         self._stop: asyncio.Future | None = None
         self._startup_error: BaseException | None = None
+        #: bound port of the TCP listener (set before __enter__ returns)
+        self.tcp_port: int | None = None
 
     # -- lifecycle ----------------------------------------------------------
 
     def __enter__(self) -> "Verifyd":
+        self._recover_orphans()
         self.scheduler.start(self.cfg.workers)
         self.stats.emit(
             "serve_start",
             socket=self.cfg.socket_path,
+            tcp=self.cfg.tcp,
             workers=self.cfg.workers,
             queue_depth=self.cfg.queue_depth,
             pid=os.getpid(),
@@ -138,11 +202,69 @@ class Verifyd:
             self._thread.join(timeout=10)
         self.scheduler.stop()
         self.stats.emit("serve_stop", **self.stats.snapshot())
+        self.cache.close()
+        if self.journal is not None:
+            self.journal.close()
         if self._stats_file is not None:
             with contextlib.suppress(OSError):
                 self._stats_file.close()
         with contextlib.suppress(FileNotFoundError):
             os.remove(self.cfg.socket_path)
+
+    def _recover_orphans(self) -> None:
+        """Journal replay: re-admit jobs a previous run accepted but never
+        answered.  Runs before the acceptor and the workers start, so
+        recovered jobs are first in line; their verdicts land in the
+        (durable) cache, which is what answers the submitter's retry."""
+        if self.journal is None:
+            return
+        for rec in self.journal.orphans():
+            text = rec.get("history", "")
+            try:
+                events = list(ev.iter_history(text))
+                hist = prepare(events, elide_trivial=True)
+            except (ev.DecodeError, ValueError) as e:
+                self.stats.emit(
+                    "orphan_invalid",
+                    fingerprint=rec.get("fp"),
+                    client=rec.get("client"),
+                    reason=str(e)[:200],
+                )
+                continue
+            job = Job(
+                id=next(self._job_ids),
+                client=str(rec.get("client") or "anon"),
+                priority=int(rec.get("priority") or 10),
+                shape=shape_key(hist),
+                fingerprint=history_fingerprint(hist),
+                events=events,
+                hist=hist,
+                no_viz=True,  # the submitter is gone; re-run for the verdict
+            )
+            self.journal.accept(
+                job=job.id,
+                fingerprint=job.fingerprint,
+                client=job.client,
+                priority=job.priority,
+                history=text,
+            )
+            try:
+                self.queue.put(job)
+            except QueueFull:
+                # Reported, not silent — and the journal still holds the
+                # accept, so the *next* restart retries the re-admission.
+                self.stats.emit(
+                    "orphan_dropped", job=job.id, fingerprint=job.fingerprint
+                )
+                continue
+            self.stats.emit(
+                "orphan",
+                job=job.id,
+                fingerprint=job.fingerprint,
+                client=job.client,
+                from_boot=rec.get("boot"),
+            )
+        self.journal.compact()
 
     def request_stop(self) -> None:
         """Thread-safe stop trigger (shutdown op, signal handler)."""
@@ -165,9 +287,12 @@ class Verifyd:
     def serve_forever(self) -> int:
         with self:
             log.info(
-                "verifyd listening on %s (queue depth %d, %d workers, "
+                "verifyd listening on %s%s (queue depth %d, %d workers, "
                 "device=%s)",
                 self.cfg.socket_path,
+                f" + tcp {self.cfg.tcp} (port {self.tcp_port})"
+                if self.cfg.tcp
+                else "",
                 self.cfg.queue_depth,
                 self.cfg.workers,
                 self.cfg.device,
@@ -187,37 +312,115 @@ class Verifyd:
     async def _main(self) -> None:
         self._loop = asyncio.get_running_loop()
         self._stop = self._loop.create_future()
+        # The stream limit IS the frame bound: readuntil past it raises
+        # LimitOverrunError, answered as a definite FrameTooLarge.
         server = await asyncio.start_unix_server(
-            self._handle, path=self.cfg.socket_path
+            functools.partial(self._handle, secret=None, deadline_s=None),
+            path=self.cfg.socket_path,
+            limit=self.cfg.frame_max_bytes,
         )
+        tcp_server = None
+        if self.cfg.tcp is not None:
+            host, port = parse_hostport(self.cfg.tcp)
+            tcp_server = await asyncio.start_server(
+                functools.partial(
+                    self._handle,
+                    secret=self.cfg.secret,
+                    deadline_s=self.cfg.conn_deadline_s,
+                ),
+                host=host,
+                port=port,
+                limit=self.cfg.frame_max_bytes,
+            )
+            self.tcp_port = tcp_server.sockets[0].getsockname()[1]
         self._started.set()
         try:
             await self._stop
         finally:
             server.close()
             await server.wait_closed()
+            if tcp_server is not None:
+                tcp_server.close()
+                await tcp_server.wait_closed()
 
     # -- connection handling ------------------------------------------------
 
+    async def _read_frame(
+        self, reader: asyncio.StreamReader, deadline_s: float | None
+    ) -> bytes | None:
+        """One frame, bounded in size (stream limit) and, on TCP, in read
+        time.  Returns None on clean EOF; raises the caller's per-frame
+        protocol failures as marker exceptions."""
+        fut = reader.readuntil(b"\n")
+        if deadline_s is not None:
+            fut = asyncio.wait_for(fut, timeout=deadline_s)
+        try:
+            return await fut
+        except asyncio.IncompleteReadError as e:
+            return e.partial or None  # truncated final frame or clean EOF
+
     async def _handle(
-        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        secret: bytes | None,
+        deadline_s: float | None,
     ) -> None:
         try:
-            while line := await reader.readline():
+            while True:
+                try:
+                    line = await self._read_frame(reader, deadline_s)
+                except (asyncio.LimitOverrunError, ValueError):
+                    # Oversized frame: a definite protocol error, then
+                    # close — the stream cannot be resynced past it.
+                    self.stats.emit("frame_error", reason="oversized")
+                    resp = err(
+                        ERR_TOO_LARGE,
+                        f"frame exceeds {self.cfg.frame_max_bytes} bytes",
+                    )
+                    await self._reply(writer, resp, secret)
+                    break
+                except asyncio.TimeoutError:
+                    self.stats.emit("frame_error", reason="read_deadline")
+                    break
+                if not line:
+                    break
+                close_after = False
                 try:
                     req = decode_frame(line)
                 except ValueError as e:
-                    resp = err(ERR_DECODE, f"malformed frame: {e}")
+                    self.stats.emit("frame_error", reason="decode")
+                    resp = err(ERR_FRAME, f"malformed frame: {e}")
                 else:
-                    resp = await self._dispatch(req)
-                writer.write(encode_frame(resp))
-                await writer.drain()
+                    if secret is not None and not verify_frame(req, secret):
+                        # Rejected before admission: nothing below the
+                        # transport ever sees an unauthenticated frame.
+                        peer = writer.get_extra_info("peername")
+                        self.stats.emit(
+                            "auth_reject", op=req.get("op"), peer=str(peer)
+                        )
+                        resp = err(ERR_AUTH, "missing or invalid frame auth")
+                        close_after = True
+                    else:
+                        resp = await self._dispatch(req)
+                await self._reply(writer, resp, secret)
+                if close_after:
+                    break
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
             writer.close()
             with contextlib.suppress(Exception):
                 await writer.wait_closed()
+
+    async def _reply(
+        self, writer: asyncio.StreamWriter, resp: dict, secret: bytes | None
+    ) -> None:
+        if secret is not None:
+            resp = sign_frame(resp, secret)
+        writer.write(encode_frame(resp))
+        await writer.drain()
 
     async def _dispatch(self, req: dict) -> dict:
         op = req.get("op")
@@ -295,9 +498,22 @@ class Verifyd:
                 self._loop.call_soon_threadsafe(_finish)
 
         job.resolve = _resolve
+        # Write-ahead: the accept record lands before the queue sees the
+        # job, so a daemon killed in between owes (and replays) the job
+        # rather than silently dropping an admission the client saw.
+        if self.journal is not None:
+            self.journal.accept(
+                job=job.id,
+                fingerprint=fingerprint,
+                client=client,
+                priority=priority,
+                history=text,
+            )
         try:
             depth = self.queue.put(job)
         except QueueFull as e:
+            if self.journal is not None:
+                self.journal.reject(job.id)
             self.stats.emit(
                 "reject",
                 client=client,
@@ -312,6 +528,8 @@ class Verifyd:
                 depth=e.depth,
             )
         except RuntimeError as e:  # queue closed: daemon is stopping
+            if self.journal is not None:
+                self.journal.reject(job.id)
             return err(ERR_SHUTTING_DOWN, str(e))
         self.stats.emit(
             "admit",

@@ -1,4 +1,5 @@
-"""verifyd wire protocol: newline-delimited JSON frames over a unix socket.
+"""verifyd wire protocol: newline-delimited JSON frames over a unix
+socket or an authenticated TCP connection.
 
 Same framing discipline as the collector's loopback transport
 (``collector/socket_s2.py``): one JSON object per line, request → one JSON
@@ -21,6 +22,23 @@ Ops:
                 and ``cached`` (answered from the verdict cache).
 ``shutdown``  → acks, then stops the daemon.
 
+Frame bounds: the daemon reads at most ``MAX_FRAME_BYTES`` per frame
+(configurable) and answers an oversized frame with the **definite**
+protocol error ``FrameTooLarge`` before closing the connection — a
+garbled client cannot balloon daemon memory through an unbounded read.
+``FrameError`` (transport-level malformation: not JSON, not an object)
+is distinct from ``DecodeError`` (a well-formed frame whose *history*
+does not decode): the first is retryable line noise, the second is the
+client's bug.
+
+Authentication (TCP only; the unix socket is filesystem-permissioned and
+carries no auth field): every frame carries ``"auth"``, the hex
+HMAC-SHA256 of the frame's canonical JSON (sorted keys, compact
+separators, ``auth`` excluded) under the shared secret.  The daemon
+verifies before dispatch — a wrong or missing secret is rejected with
+``AuthError`` before anything touches the admission queue — and signs
+its replies so the client can verify them back.
+
 Backpressure: a full admission queue answers ``submit`` immediately with
 ``{"err": {"class": "QueueFull", "retry_after_s": <hint>}}`` — the
 documented reject-with-retry-after reply; the daemon never buffers beyond
@@ -28,34 +46,55 @@ its configured depth.
 
 Exit-code conventions for the ``submit`` CLI: verdicts map to the
 ``check`` exit codes (0 linearizable / 1 not / 2 inconclusive, 64 decode
-errors); ``EXIT_BUSY`` (75, EX_TEMPFAIL) for a backpressure reject and
-``EXIT_UNAVAILABLE`` (69, EX_UNAVAILABLE) when no daemon answers on the
-socket.
+errors); ``EXIT_BUSY`` (75, EX_TEMPFAIL) for a backpressure reject after
+retries; ``EXIT_UNAVAILABLE`` (69, EX_UNAVAILABLE) when no daemon ever
+answered a connect; ``EXIT_PROTOCOL`` (76, EX_PROTOCOL) when a daemon
+*was* reached but refused after retries (bad secret, persistent frame
+errors, connection lost mid-call).
 """
 
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac
 import json
 
 __all__ = [
     "PROTOCOL_VERSION",
+    "MAX_FRAME_BYTES",
     "ERR_QUEUE_FULL",
     "ERR_DECODE",
+    "ERR_FRAME",
+    "ERR_TOO_LARGE",
+    "ERR_AUTH",
     "ERR_INTERNAL",
     "ERR_SHUTTING_DOWN",
     "EXIT_BUSY",
     "EXIT_UNAVAILABLE",
+    "EXIT_PROTOCOL",
     "VERDICT_EXIT",
     "encode_frame",
     "decode_frame",
+    "sign_frame",
+    "verify_frame",
+    "parse_hostport",
     "ok",
     "err",
 ]
 
 PROTOCOL_VERSION = 1
 
+#: Default per-frame read bound.  A submitted history rides inside one
+#: frame, so this also caps history size (~8 MiB JSONL ≈ 10^5 events —
+#: far past what any engine decides); the old implicit bound was
+#: asyncio's 64 KiB stream limit, which *rejected* legal large histories.
+MAX_FRAME_BYTES = 8 << 20
+
 ERR_QUEUE_FULL = "QueueFull"
 ERR_DECODE = "DecodeError"
+ERR_FRAME = "FrameError"
+ERR_TOO_LARGE = "FrameTooLarge"
+ERR_AUTH = "AuthError"
 ERR_INTERNAL = "InternalError"
 ERR_SHUTTING_DOWN = "ShuttingDown"
 
@@ -63,7 +102,8 @@ ERR_SHUTTING_DOWN = "ShuttingDown"
 VERDICT_EXIT = {"ok": 0, "illegal": 1, "unknown": 2}
 
 EXIT_BUSY = 75  # EX_TEMPFAIL: queue full, retry after the hint
-EXIT_UNAVAILABLE = 69  # EX_UNAVAILABLE: no daemon on the socket
+EXIT_UNAVAILABLE = 69  # EX_UNAVAILABLE: no daemon ever answered a connect
+EXIT_PROTOCOL = 76  # EX_PROTOCOL: daemon reached but refused after retries
 
 
 def encode_frame(obj: dict) -> bytes:
@@ -77,6 +117,34 @@ def decode_frame(line: bytes) -> dict:
     if not isinstance(obj, dict):
         raise ValueError(f"frame must be a JSON object, got {type(obj).__name__}")
     return obj
+
+
+def _frame_mac(obj: dict, secret: bytes) -> str:
+    """HMAC-SHA256 over the canonical serialization of ``obj`` minus its
+    ``auth`` field.  Canonical = sorted keys + compact separators, so both
+    ends derive identical bytes regardless of insertion order."""
+    body = {k: v for k, v in obj.items() if k != "auth"}
+    canon = json.dumps(body, sort_keys=True, separators=(",", ":")).encode("utf-8")
+    return _hmac.new(secret, canon, hashlib.sha256).hexdigest()
+
+
+def sign_frame(obj: dict, secret: bytes) -> dict:
+    return {**obj, "auth": _frame_mac(obj, secret)}
+
+
+def verify_frame(obj: dict, secret: bytes) -> bool:
+    mac = obj.get("auth")
+    return isinstance(mac, str) and _hmac.compare_digest(
+        mac, _frame_mac(obj, secret)
+    )
+
+
+def parse_hostport(addr: str) -> tuple[str, int]:
+    """``host:port`` → (host, port); bare ``:port`` binds all interfaces."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"expected HOST:PORT, got {addr!r}")
+    return host or "0.0.0.0", int(port)
 
 
 def ok(payload: dict) -> dict:

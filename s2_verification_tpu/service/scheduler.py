@@ -79,6 +79,7 @@ class Scheduler:
         device_rows: int | None = None,
         attempt_timeout_s: float = 900.0,
         max_restarts: int = 2,
+        journal=None,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -96,6 +97,7 @@ class Scheduler:
         self.device_rows = device_rows
         self.attempt_timeout_s = attempt_timeout_s
         self.max_restarts = max_restarts
+        self.journal = journal
         self._threads: list[threading.Thread] = []
         self._stopping = False
 
@@ -130,7 +132,23 @@ class Scheduler:
                 except Exception as e:  # one bad job must not kill the worker
                     log.exception("job %d failed", job.id)
                     reply = err("InternalError", repr(e), job=job.id)
+                    # Close the journal record even on failure: a poison
+                    # job must not re-run on every restart forever.
+                    self._mark_done(job, verdict=None, outcome="error")
                 job.resolve(reply)
+
+    def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
+        if self.journal is None:
+            return
+        try:
+            self.journal.done(
+                job=job.id,
+                fingerprint=job.fingerprint,
+                verdict=verdict,
+                outcome=outcome,
+            )
+        except (OSError, ValueError):
+            log.exception("job %d: journal done-mark failed", job.id)
 
     def _run_job(self, job: Job) -> dict:
         queue_wait = time.monotonic() - job.submitted_at
@@ -141,6 +159,11 @@ class Scheduler:
             cached.update(cached=True, job=job.id, queue_wait_s=round(queue_wait, 4))
             self.stats.emit(
                 "cache_hit", stage="execute", job=job.id, client=job.client
+            )
+            self._mark_done(
+                job,
+                verdict=cached.get("verdict"),
+                outcome=str(cached.get("outcome", "cached")),
             )
             return ok(cached)
 
@@ -179,6 +202,11 @@ class Scheduler:
         # healthier device or a bigger budget and deserves a fresh run.
         if res.outcome != CheckOutcome.UNKNOWN:
             self.cache.put(job.fingerprint, payload)
+        # Done-mark after the cache put: a crash in between re-runs the
+        # job (at-least-once), and the rerun answers from the cache.
+        self._mark_done(
+            job, verdict=payload["verdict"], outcome=res.outcome.value
+        )
         self.stats.emit(
             "done",
             job=job.id,

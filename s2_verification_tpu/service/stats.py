@@ -9,7 +9,13 @@ Every notable daemon event becomes one JSON line on the configured sink
 
 Event names: ``serve_start``, ``admit``, ``reject``, ``cache_hit``,
 ``start``, ``done``, ``decode_error``, ``degrade`` (supervised device job
-fell back to CPU), ``serve_stop``.  ``shape_warm`` marks a job whose
+fell back to CPU), ``serve_stop``; durability and remote-transport
+events: ``cache_loaded`` (persistent verdict segments replayed at boot),
+``orphan`` (journal replay re-admitted an accepted-but-unanswered job),
+``orphan_dropped`` / ``orphan_invalid`` (reported, not silently lost),
+``auth_reject`` (TCP frame failed HMAC verification — rejected before
+admission), ``frame_error`` (oversized or malformed frame).
+``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
 
@@ -43,6 +49,10 @@ class ServiceStats:
             "verdict_ok": 0,
             "verdict_illegal": 0,
             "verdict_unknown": 0,
+            "auth_rejects": 0,
+            "frame_errors": 0,
+            "orphans_recovered": 0,
+            "cache_loaded": 0,
         }
         self._wall_total_s = 0.0
         self._shapes_seen: set[str] = set()
@@ -77,6 +87,14 @@ class ServiceStats:
             self._counters["decode_errors"] += 1
         elif event == "degrade":
             self._counters["degraded"] += 1
+        elif event == "auth_reject":
+            self._counters["auth_rejects"] += 1
+        elif event == "frame_error":
+            self._counters["frame_errors"] += 1
+        elif event == "orphan":
+            self._counters["orphans_recovered"] += 1
+        elif event == "cache_loaded":
+            self._counters["cache_loaded"] = int(fields.get("entries", 0))
         elif event == "done":
             self._counters["completed"] += 1
             self._wall_total_s += float(fields.get("wall_s", 0.0))

@@ -1,0 +1,404 @@
+"""Chaos harness: prove verifyd's durability and transport robustness.
+
+Three scenarios, each asserting **verdict parity with the one-shot
+``check`` CLI** (the ground truth this repo reproduces) and **zero lost
+accepted jobs**:
+
+1. **Fault matrix** — submissions ride the authenticated TCP transport
+   through a fault-injecting frame proxy (``service/chaosproxy.py``)
+   that truncates / garbles / delays / duplicates every Nth frame.  The
+   retrying client must still land every verdict, and every verdict must
+   equal the one-shot exit code.
+2. **Auth probes** — frames with a wrong or missing secret must be
+   rejected before admission (daemon ``submitted`` counter unmoved).
+3. **Crash + recovery** — a daemon with a durable ``--state-dir`` is
+   SIGKILLed while holding accepted-but-unanswered jobs.  The restarted
+   daemon must re-run every orphan (journal replay), answer every
+   accepted fingerprint with the one-shot verdict, and a *third* boot
+   must answer those fingerprints from the recovered verdict cache
+   without invoking a checker (``completed`` stays 0).
+
+Exit 0 when every assertion holds; 1 with the failures listed on stderr.
+One JSON summary line lands on stdout.
+
+Usage:
+    python scripts/chaos_bench.py [--quick] [--state-root DIR]
+
+``--quick`` is the smoke configuration (2 histories, 2 faults);
+the default is the full matrix.  ``make chaos`` runs --quick.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from s2_verification_tpu.cli import main as cli_main
+from s2_verification_tpu.service.chaosproxy import ChaosProxy
+from s2_verification_tpu.service.client import (
+    VerifydClient,
+    VerifydError,
+    VerifydRefused,
+    VerifydUnavailable,
+)
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold  # tests/helpers.py: the history builder
+
+SECRET = b"chaos-bench-shared-secret"
+
+
+# -- corpus ------------------------------------------------------------------
+
+
+def _render(h: H) -> str:
+    import io
+
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def build_corpus(n: int) -> list[tuple[str, str]]:
+    """``n`` (name, history-JSONL) pairs, alternating linearizable and
+    not, with distinct record hashes so every fingerprint is distinct."""
+    corpus = []
+    for i in range(n):
+        base = 1000 * (i + 1)
+        h = H()
+        if i % 2 == 0:
+            h.append_ok(1, [base + 1], tail=1)
+            h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+            h.append_ok(2, [base + 2, base + 3], tail=3)
+            h.read_ok(1, tail=3, stream_hash=fold([base + 1, base + 2, base + 3]))
+            corpus.append((f"good{i}", _render(h)))
+        else:
+            h.append_ok(1, [base + 1], tail=1)
+            h.read_ok(2, tail=1, stream_hash=base)  # impossible stream hash
+            corpus.append((f"bad{i}", _render(h)))
+    return corpus
+
+
+def one_shot_verdicts(corpus, workdir: str) -> dict[str, int]:
+    """Ground truth: the one-shot ``check`` exit code per history."""
+    out = {}
+    for name, text in corpus:
+        path = os.path.join(workdir, f"{name}.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+        out[name] = cli_main(["check", "-file", path, "-no-viz"])
+    return out
+
+
+# -- scenario 1: the fault matrix --------------------------------------------
+
+
+def run_fault_matrix(corpus, expect, faults, failures: list[str]) -> dict:
+    tmp = tempfile.mkdtemp(prefix="chaos-faults-")
+    cfg = VerifydConfig(
+        socket_path=os.path.join(tmp, "verifyd.sock"),
+        workers=1,
+        device="off",
+        no_viz=True,
+        out_dir=os.path.join(tmp, "viz"),
+        tcp="127.0.0.1:0",
+        secret=SECRET,
+    )
+    summary = {}
+    try:
+        with Verifyd(cfg) as daemon:
+            for fault in faults:
+                with ChaosProxy(
+                    ("127.0.0.1", daemon.tcp_port), fault=fault, every=2
+                ) as proxy:
+                    client = VerifydClient(
+                        f"127.0.0.1:{proxy.port}", timeout=60, secret=SECRET
+                    )
+                    verdicts = 0
+                    for name, text in corpus:
+                        try:
+                            reply = client.submit_with_retry(
+                                text,
+                                client=f"chaos-{fault}",
+                                retries=8,
+                                backoff_s=0.05,
+                                no_viz=True,
+                            )
+                        except VerifydError as e:
+                            failures.append(
+                                f"fault={fault} {name}: no verdict ({e})"
+                            )
+                            continue
+                        verdicts += 1
+                        if reply.get("verdict") != expect[name]:
+                            failures.append(
+                                f"fault={fault} {name}: verdict "
+                                f"{reply.get('verdict')} != one-shot {expect[name]}"
+                            )
+                    if fault != "none" and proxy.faulted == 0:
+                        failures.append(
+                            f"fault={fault}: proxy never fired — matrix is vacuous"
+                        )
+                    summary[fault] = {
+                        "verdicts": verdicts,
+                        "frames_faulted": proxy.faulted,
+                    }
+                    print(
+                        f"# fault={fault}: {verdicts}/{len(corpus)} verdicts, "
+                        f"{proxy.faulted} frames faulted",
+                        file=sys.stderr,
+                    )
+            # scenario 2 rides the same daemon: unauthenticated probes
+            summary["auth"] = run_auth_probes(daemon, corpus, failures)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return summary
+
+
+def run_auth_probes(daemon, corpus, failures: list[str]) -> dict:
+    before = daemon.stats.snapshot()["submitted"]
+    # wrong secret: definite refusal, no retry loop
+    bad = VerifydClient(
+        f"127.0.0.1:{daemon.tcp_port}", timeout=10, secret=b"wrong-secret"
+    )
+    try:
+        bad.submit(corpus[0][1], client="intruder")
+        failures.append("auth: wrong secret was accepted")
+    except VerifydRefused as e:
+        if e.cls != "AuthError":
+            failures.append(f"auth: wrong secret → {e.cls}, expected AuthError")
+        if e.transient:
+            failures.append("auth: AuthError marked transient (would retry)")
+    except (VerifydError, VerifydUnavailable) as e:
+        failures.append(f"auth: wrong secret → unexpected {e!r}")
+    # missing auth field entirely: raw unsigned frame
+    with socket.create_connection(("127.0.0.1", daemon.tcp_port), timeout=10) as s:
+        s.sendall(b'{"op":"ping"}\n')
+        raw = s.recv(1 << 16)
+    try:
+        err_cls = json.loads(raw)["err"]["class"]
+    except (ValueError, KeyError):
+        err_cls = None
+    if err_cls != "AuthError":
+        failures.append(f"auth: unsigned frame → {err_cls}, expected AuthError")
+    after = daemon.stats.snapshot()["submitted"]
+    if after != before:
+        failures.append(
+            "auth: unauthenticated frames reached admission "
+            f"(submitted {before} → {after})"
+        )
+    rejects = daemon.stats.snapshot()["auth_rejects"]
+    print(f"# auth: {rejects} rejects, admission untouched", file=sys.stderr)
+    return {"auth_rejects": rejects}
+
+
+# -- scenario 3: crash + recovery --------------------------------------------
+
+
+def _spawn_daemon(sock: str, state_dir: str, tmp: str, workers: int):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "s2_verification_tpu",
+            "serve",
+            "-socket",
+            sock,
+            "--workers",
+            str(workers),
+            "--device",
+            "off",
+            "-no-viz",
+            "--state-dir",
+            state_dir,
+            "--stats-log",
+            "",
+            "-out-dir",
+            os.path.join(tmp, "viz"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode} before binding")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError("daemon socket never appeared")
+        time.sleep(0.05)
+    return proc
+
+
+def _stop_daemon(sock: str, proc) -> None:
+    try:
+        VerifydClient(sock, timeout=10).shutdown()
+        proc.wait(timeout=30)
+    except (VerifydError, OSError, subprocess.TimeoutExpired):
+        proc.kill()
+        proc.wait()
+
+
+def run_crash_recovery(corpus, expect, failures: list[str]) -> dict:
+    tmp = tempfile.mkdtemp(prefix="chaos-crash-")
+    state = os.path.join(tmp, "state")
+    sock = os.path.join(tmp, "verifyd.sock")
+    summary: dict = {}
+    try:
+        # Boot 1: workers=0 — admission only, nothing drains.  Every
+        # submission is accepted (journaled) and still unanswered when
+        # the SIGKILL lands: the worst-case crash window.
+        proc = _spawn_daemon(sock, state, tmp, workers=0)
+        client = VerifydClient(sock, timeout=0.5)
+        accepted = 0
+        for name, text in corpus:
+            try:
+                client.submit(text, client="chaos-crash", no_viz=True)
+                failures.append(f"crash: {name} answered with zero workers")
+            except (VerifydRefused, VerifydUnavailable, VerifydError):
+                accepted += 1  # timed out waiting for the verdict: accepted
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        os.remove(sock)  # SIGKILL leaves the socket file; serve refuses it
+        summary["accepted_then_killed"] = accepted
+
+        # Boot 2: workers=1 — journal replay must re-run every orphan.
+        proc = _spawn_daemon(sock, state, tmp, workers=1)
+        client = VerifydClient(sock, timeout=120)
+        deadline = time.monotonic() + 120
+        while True:
+            snap = client.stats()
+            if snap["orphans_recovered"] >= len(corpus) and snap[
+                "completed"
+            ] >= len(corpus):
+                break
+            if time.monotonic() > deadline:
+                failures.append(
+                    f"crash: orphans never finished (recovered "
+                    f"{snap['orphans_recovered']}, completed {snap['completed']}, "
+                    f"want {len(corpus)})"
+                )
+                break
+            time.sleep(0.2)
+        summary["orphans_recovered"] = snap["orphans_recovered"]
+        # Zero lost jobs: every accepted fingerprint now answers, warm,
+        # with the one-shot verdict.
+        for name, text in corpus:
+            reply = client.submit(text, client="chaos-verify", no_viz=True)
+            if reply.get("verdict") != expect[name]:
+                failures.append(
+                    f"crash: {name} verdict {reply.get('verdict')} != "
+                    f"one-shot {expect[name]}"
+                )
+            if not reply.get("cached"):
+                failures.append(f"crash: {name} re-ran instead of cache hit")
+        _stop_daemon(sock, proc)
+        os.path.exists(sock) and os.remove(sock)
+
+        # Boot 3: the durable verdict cache alone must answer — the
+        # journal is compacted, so completed==0 proves no checker ran.
+        proc = _spawn_daemon(sock, state, tmp, workers=1)
+        client = VerifydClient(sock, timeout=120)
+        for name, text in corpus:
+            reply = client.submit(text, client="chaos-warm", no_viz=True)
+            if not reply.get("cached") or reply.get("verdict") != expect[name]:
+                failures.append(
+                    f"crash: warm boot missed cache for {name} "
+                    f"(cached={reply.get('cached')}, verdict={reply.get('verdict')})"
+                )
+        snap = client.stats()
+        if snap["completed"] != 0:
+            failures.append(
+                f"crash: warm boot invoked a checker ({snap['completed']} jobs)"
+            )
+        if snap["cache_loaded"] < len(corpus):
+            failures.append(
+                f"crash: warm boot loaded {snap['cache_loaded']} cached "
+                f"verdicts, want >= {len(corpus)}"
+            )
+        summary["warm_cache_loaded"] = snap["cache_loaded"]
+        _stop_daemon(sock, proc)
+        print(
+            f"# crash: {accepted} accepted+killed, "
+            f"{summary['orphans_recovered']} orphans re-run, warm boot served "
+            f"{len(corpus)} verdicts with 0 checker invocations",
+            file=sys.stderr,
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return summary
+
+
+# -- driver ------------------------------------------------------------------
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--quick", action="store_true", help="smoke config (make chaos)"
+    )
+    ap.add_argument(
+        "--histories", type=int, default=None, help="corpus size override"
+    )
+    args = ap.parse_args()
+
+    n = args.histories or (2 if args.quick else 6)
+    faults = ["garble", "truncate"] if args.quick else [
+        "none", "truncate", "garble", "delay", "duplicate"
+    ]
+
+    corpus = build_corpus(n)
+    workdir = tempfile.mkdtemp(prefix="chaos-corpus-")
+    failures: list[str] = []
+    try:
+        expect = one_shot_verdicts(corpus, workdir)
+        print(f"# one-shot ground truth: {expect}", file=sys.stderr)
+        t0 = time.monotonic()
+        fault_summary = run_fault_matrix(corpus, expect, faults, failures)
+        crash_summary = run_crash_recovery(corpus, expect, failures)
+        wall = time.monotonic() - t0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print(
+        json.dumps(
+            {
+                "metric": "chaos_matrix",
+                "histories": n,
+                "faults": faults,
+                "failures": len(failures),
+                "wall_s": round(wall, 2),
+                "fault_matrix": fault_summary,
+                "crash_recovery": crash_summary,
+            }
+        ),
+        flush=True,
+    )
+    print(
+        f"# chaos: {'PASS' if not failures else 'FAIL'} "
+        f"({len(failures)} failures, {wall:.1f}s)",
+        file=sys.stderr,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,6 +48,20 @@ def _host_cpus() -> int:
         return os.cpu_count() or 1
 
 
+def _published_baseline() -> float | None:
+    """BASELINE.json published.service_jobs_per_sec.value, if recorded."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BASELINE.json",
+    )
+    try:
+        with open(path, encoding="utf-8") as f:
+            entry = json.load(f)["published"]["service_jobs_per_sec"]
+        return float(entry["value"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
 def _seed_histories(out_dir: str) -> None:
     from s2_verification_tpu.cli import main as cli_main
 
@@ -196,13 +210,17 @@ def main() -> int:
             f"{rejects[0]} backpressure rejects",
             file=sys.stderr,
         )
+        value = round(done / wall, 2) if wall > 0 else 0.0
+        baseline = _published_baseline()
         print(
             json.dumps(
                 {
                     "metric": "service_jobs_per_sec",
-                    "value": round(done / wall, 2) if wall > 0 else 0.0,
+                    "value": value,
                     "unit": "jobs/s",
-                    "vs_baseline": 0.0,  # first serving number: no baseline yet
+                    # speedup vs BASELINE.json published number; 0.0 only
+                    # until a baseline is recorded there
+                    "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
                     "backend": "verifyd",
                     "host_cpus": _host_cpus(),
                     "cache_hits": cached_n[0],

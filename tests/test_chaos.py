@@ -1,0 +1,113 @@
+"""Chaos coverage: the fault-injecting frame proxy and the full harness.
+
+The per-fault tests run in-process against one daemon (fast, tier-1);
+the full matrix — subprocess daemons, SIGKILL mid-job, three boots — is
+``scripts/chaos_bench.py``, run here under the ``slow`` marker and by
+``make chaos``.
+"""
+
+import io
+import os
+import subprocess
+import sys
+
+import pytest
+
+from s2_verification_tpu.service.chaosproxy import ChaosProxy
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+SECRET = b"chaos-test-secret"
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    return _text(h)
+
+
+def bad_history() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=12345)
+    return _text(h)
+
+
+@pytest.fixture(scope="module")
+def tcp_daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos")
+    cfg = VerifydConfig(
+        socket_path=str(tmp / "verifyd.sock"),
+        workers=1,
+        device="off",
+        no_viz=True,
+        out_dir=str(tmp / "viz"),
+        tcp="127.0.0.1:0",
+        secret=SECRET,
+    )
+    with Verifyd(cfg) as daemon:
+        yield daemon
+
+
+@pytest.mark.parametrize("fault", ["truncate", "garble", "delay", "duplicate"])
+def test_verdicts_survive_fault(tcp_daemon, fault):
+    with ChaosProxy(
+        ("127.0.0.1", tcp_daemon.tcp_port), fault=fault, every=2, delay_s=0.05
+    ) as proxy:
+        client = VerifydClient(
+            f"127.0.0.1:{proxy.port}", timeout=60, secret=SECRET
+        )
+        # every=2 and two calls per loop guarantee the fault fires, and
+        # the deterministic schedule guarantees a retry lands clean
+        for _ in range(2):
+            good = client.submit_with_retry(
+                good_history(), client=f"chaos-{fault}", retries=6,
+                backoff_s=0.01, no_viz=True,
+            )
+            bad = client.submit_with_retry(
+                bad_history(), client=f"chaos-{fault}", retries=6,
+                backoff_s=0.01, no_viz=True,
+            )
+            assert good["verdict"] == 0
+            assert bad["verdict"] == 1
+        assert proxy.faulted >= 1, "matrix would be vacuous"
+
+
+def test_proxy_passthrough_is_transparent(tcp_daemon):
+    with ChaosProxy(("127.0.0.1", tcp_daemon.tcp_port), fault="none") as proxy:
+        client = VerifydClient(
+            f"127.0.0.1:{proxy.port}", timeout=60, secret=SECRET
+        )
+        assert client.ping()["server"] == "verifyd"
+        assert proxy.faulted == 0
+
+
+def test_proxy_rejects_unknown_fault():
+    with pytest.raises(ValueError):
+        ChaosProxy(("127.0.0.1", 1), fault="explode")
+
+
+@pytest.mark.slow
+def test_full_chaos_harness():
+    """The whole contract: fault matrix + auth probes + SIGKILL crash
+    recovery across three daemon boots, verdict parity throughout."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "chaos_bench.py"), "--quick"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert proc.returncode == 0, f"chaos harness failed:\n{proc.stderr[-4000:]}"

@@ -1,0 +1,319 @@
+"""Durable verifyd state: segment log, persistent verdict cache, and the
+admission journal — the crash-safety contract under surgical corruption.
+
+Everything here is CPU-only and in-process (the SIGKILL end of the
+spectrum lives in ``scripts/chaos_bench.py`` / ``tests/test_chaos.py``):
+the tests corrupt the on-disk bytes directly, which exercises the same
+recovery paths a torn write would reach without needing a real crash.
+"""
+
+import io
+import json
+import os
+import struct
+import time
+
+import pytest
+
+from s2_verification_tpu.service.cache import VerdictCache, history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.journal import JobJournal
+from s2_verification_tpu.service.protocol import encode_frame
+from s2_verification_tpu.utils import events as ev
+from s2_verification_tpu.utils.seglog import SegmentLog
+
+from helpers import H, fold
+
+# -- fixtures (mirrors test_service.py) --------------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    h.append_ok(2, [222, 333], tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([111, 222, 333]))
+    return _text(h)
+
+
+def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        no_viz=True,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=str(tmp_path / "stats.jsonl"),
+        state_dir=str(tmp_path / "state"),
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _segments(directory) -> list[str]:
+    return sorted(
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
+        if f.startswith("seg-")
+    )
+
+
+# -- segment log --------------------------------------------------------------
+
+
+def test_seglog_round_trip_and_rotation(tmp_path):
+    d = str(tmp_path / "log")
+    log = SegmentLog(d, max_segment_bytes=64)
+    payloads = [f"rec-{i}".encode() for i in range(20)]
+    for p in payloads:
+        log.append(p)
+    log.close()
+    assert len(_segments(d)) > 1  # 20 records cannot fit one 64-byte segment
+
+    log2 = SegmentLog(d)
+    assert log2.replay_all() == payloads
+    rec = log2.recovery
+    assert rec.records == 20 and rec.torn_tail_bytes == 0 and rec.bad_segments == 0
+    log2.close()
+
+
+def test_seglog_max_segments_drops_oldest(tmp_path):
+    d = str(tmp_path / "log")
+    log = SegmentLog(d, max_segment_bytes=64, max_segments=2)
+    for i in range(30):
+        log.append(f"rec-{i:04d}".encode())
+    log.close()
+    assert len(_segments(d)) <= 2
+    replayed = SegmentLog(d).replay_all()
+    # the newest records survive; the oldest aged out with their segment
+    assert replayed and replayed[-1] == b"rec-0029"
+    assert b"rec-0000" not in replayed
+
+
+def test_seglog_torn_final_record_recovers_prefix(tmp_path):
+    d = str(tmp_path / "log")
+    log = SegmentLog(d)
+    for i in range(5):
+        log.append(f"rec-{i}".encode())
+    log.close()
+    seg = _segments(d)[-1]
+    # tear mid-record: drop the last 3 bytes (a crashed write)
+    with open(seg, "r+b") as f:
+        f.truncate(os.path.getsize(seg) - 3)
+
+    log2 = SegmentLog(d)
+    assert log2.replay_all() == [f"rec-{i}".encode() for i in range(4)]
+    rec = log2.recovery
+    assert rec.torn_tail_bytes > 0 and rec.bad_segments == 0
+    # appends after a torn tail go to a FRESH segment — the damaged file
+    # is never extended past its valid prefix
+    log2.append(b"after-tear")
+    log2.close()
+    assert len(_segments(d)) == 2
+    assert SegmentLog(d).replay_all() == [
+        b"rec-0",
+        b"rec-1",
+        b"rec-2",
+        b"rec-3",
+        b"after-tear",
+    ]
+
+
+def test_seglog_corrupted_record_drops_segment_tail(tmp_path):
+    d = str(tmp_path / "log")
+    log = SegmentLog(d)
+    for i in range(5):
+        log.append(f"rec-{i}".encode())
+    log.close()
+    seg = _segments(d)[-1]
+    hdr = struct.calcsize("<II")
+    rec_size = hdr + len(b"rec-0")
+    # flip a payload byte inside record 2: its CRC fails, and nothing
+    # past it in the segment can be trusted (lengths may be lies too)
+    with open(seg, "r+b") as f:
+        f.seek(2 * rec_size + hdr)
+        b = f.read(1)
+        f.seek(2 * rec_size + hdr)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    log2 = SegmentLog(d)
+    assert log2.replay_all() == [b"rec-0", b"rec-1"]
+    assert log2.recovery.dropped_records_possible
+    log2.close()
+
+
+# -- persistent verdict cache -------------------------------------------------
+
+
+def test_verdict_cache_restart_round_trip(tmp_path):
+    d = str(tmp_path / "verdicts")
+    c = VerdictCache(capacity=16, persist_dir=d)
+    c.put("fp-a", {"verdict": 0, "outcome": "ok"})
+    c.put("fp-b", {"verdict": 1, "outcome": "illegal"})
+    c.close()
+
+    c2 = VerdictCache(capacity=16, persist_dir=d)
+    assert c2.loaded == 2
+    assert c2.get("fp-a") == {"verdict": 0, "outcome": "ok"}
+    assert c2.get("fp-b")["verdict"] == 1
+    c2.close()
+
+
+def test_verdict_cache_torn_tail_keeps_valid_prefix(tmp_path):
+    d = str(tmp_path / "verdicts")
+    c = VerdictCache(capacity=16, persist_dir=d)
+    c.put("fp-a", {"verdict": 0})
+    c.put("fp-b", {"verdict": 1})
+    c.close()
+    seg = _segments(d)[-1]
+    with open(seg, "r+b") as f:  # tear the final (fp-b) record
+        f.truncate(os.path.getsize(seg) - 2)
+
+    c2 = VerdictCache(capacity=16, persist_dir=d)
+    assert c2.loaded == 1
+    assert c2.get("fp-a") == {"verdict": 0}
+    assert c2.get("fp-b") is None  # lost verdict = re-search, never wrong
+    assert c2.recovery.torn_tail_bytes > 0
+    c2.close()
+
+
+def test_verdict_cache_foreign_records_skipped(tmp_path):
+    d = str(tmp_path / "verdicts")
+    log = SegmentLog(d)
+    log.append(b"not json at all")
+    log.append(json.dumps({"fp": "fp-x", "p": {"verdict": 2}}).encode())
+    log.append(json.dumps({"wrong": "shape"}).encode())
+    log.close()
+    c = VerdictCache(capacity=16, persist_dir=d)
+    assert c.loaded == 1 and c.get("fp-x") == {"verdict": 2}
+    c.close()
+
+
+# -- admission journal --------------------------------------------------------
+
+
+def test_journal_orphans_and_compaction(tmp_path):
+    d = str(tmp_path / "journal")
+    j = JobJournal(d)
+    j.accept(job=1, fingerprint="fp-1", client="a", priority=10, history="h1")
+    j.accept(job=2, fingerprint="fp-2", client="b", priority=5, history="h2")
+    j.accept(job=3, fingerprint="fp-3", client="c", priority=1, history="h3")
+    j.done(job=1, fingerprint="fp-1", verdict=0, outcome="ok")
+    j.reject(job=3)  # queue-full after the accept landed: record closed
+    j.close()
+
+    j2 = JobJournal(d)  # a new boot
+    orphans = j2.orphans()
+    assert [o["fp"] for o in orphans] == ["fp-2"]
+    assert orphans[0]["history"] == "h2" and orphans[0]["client"] == "b"
+
+    # re-accept under the new boot, then compact: prior boot disappears
+    j2.accept(job=1, fingerprint="fp-2", client="b", priority=5, history="h2")
+    j2.compact()
+    j2.done(job=1, fingerprint="fp-2", verdict=0, outcome="ok")
+    j2.close()
+    assert JobJournal(d).orphans() == []
+
+
+def test_journal_duplicate_fingerprints_collapse(tmp_path):
+    j = JobJournal(str(tmp_path / "journal"))
+    j.accept(job=1, fingerprint="fp-same", client="a", priority=10, history="h")
+    j.accept(job=2, fingerprint="fp-same", client="a", priority=10, history="h")
+    j.close()
+    j2 = JobJournal(str(tmp_path / "journal"))
+    assert len(j2.orphans()) == 1  # one re-run; the cache answers the twin
+    j2.close()
+
+
+# -- daemon-level restart behavior -------------------------------------------
+
+
+def test_daemon_restart_answers_cached_without_checker(tmp_path):
+    good = good_history()
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        first = client.submit(good, client="dur")
+        assert first["verdict"] == 0 and first["cached"] is False
+
+    cfg2 = _daemon_cfg(tmp_path, socket_path=str(tmp_path / "v2.sock"))
+    with Verifyd(cfg2) as daemon2:
+        assert daemon2.cache.loaded == 1
+        client = VerifydClient(cfg2.socket_path, timeout=120)
+        again = client.submit(good, client="dur")
+        assert again["verdict"] == 0 and again["cached"] is True
+        snap = client.stats()
+        # the fingerprint was answered at admission: no job ever ran
+        assert snap["completed"] == 0 and snap["cache_loaded"] == 1
+
+
+def test_daemon_orphan_replay_after_unclean_stop(tmp_path):
+    good = good_history()
+    # Boot 1: workers=0 — the job is accepted (journaled) but never run;
+    # exiting with it queued models a crash mid-job for the journal's
+    # purposes (no done record lands).
+    cfg = _daemon_cfg(tmp_path, workers=0)
+    with Verifyd(cfg) as daemon:
+        import socket as _socket
+
+        with _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM) as s:
+            s.connect(cfg.socket_path)
+            s.sendall(
+                encode_frame({"op": "submit", "history": good, "client": "w"})
+            )
+            deadline = time.monotonic() + 10
+            while daemon.stats.snapshot()["admitted"] < 1:
+                assert time.monotonic() < deadline, "job never admitted"
+                time.sleep(0.01)
+
+    # Boot 2: replay must re-run the orphan and cache its verdict.
+    cfg2 = _daemon_cfg(tmp_path, socket_path=str(tmp_path / "v2.sock"))
+    with Verifyd(cfg2) as daemon2:
+        client = VerifydClient(cfg2.socket_path, timeout=120)
+        deadline = time.monotonic() + 60
+        while True:
+            snap = client.stats()
+            if snap["orphans_recovered"] >= 1 and snap["completed"] >= 1:
+                break
+            assert time.monotonic() < deadline, f"orphan never re-ran: {snap}"
+            time.sleep(0.05)
+        reply = client.submit(good, client="w2")
+        assert reply["verdict"] == 0 and reply["cached"] is True
+        # at-least-once promise kept and closed: the journal is clean now
+        assert daemon2.journal.orphans() == []
+
+    # Boot 3: nothing left to recover.
+    cfg3 = _daemon_cfg(tmp_path, socket_path=str(tmp_path / "v3.sock"))
+    with Verifyd(cfg3) as daemon3:
+        assert daemon3.stats.snapshot()["orphans_recovered"] == 0
+
+
+def test_daemon_orphan_with_invalid_history_is_reported(tmp_path):
+    state = str(tmp_path / "state")
+    j = JobJournal(os.path.join(state, "journal"))
+    j.accept(job=1, fingerprint="fp-junk", client="x", priority=10, history="{broken\n")
+    j.close()
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg) as daemon:
+        snap = daemon.stats.snapshot()
+        assert snap["orphans_recovered"] == 0  # reported, not resurrected
+    with open(tmp_path / "stats.jsonl", encoding="utf-8") as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert any(e["ev"] == "orphan_invalid" for e in events)
+
+
+def test_fingerprint_of_history(tmp_path):
+    """Regression guard: the durable cache keys on the same fingerprint
+    across process lifetimes (no per-boot salt may sneak in)."""
+    from s2_verification_tpu.checker.entries import prepare
+
+    hist = prepare(list(ev.iter_history(good_history())), elide_trivial=True)
+    assert history_fingerprint(hist) == history_fingerprint(hist)

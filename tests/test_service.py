@@ -388,3 +388,62 @@ def test_stats_retry_hint_is_clamped():
     assert s.retry_after_hint(4) == 4.0  # cold daemon assumes 1s/job
     s.emit("done", wall_s=20.0, verdict=0)
     assert s.retry_after_hint(100) == 30.0  # depth x avg, ceiling
+
+
+# -- supervised-device degradation -------------------------------------------
+
+
+def test_wedged_device_job_degrades_to_cpu(tmp_path, monkeypatch):
+    """A job whose device escalation never answers (wedged TPU: supervise
+    returns None) must still get a verdict — from the unbounded CPU close
+    — and the degradation must be observable in the stats stream."""
+    from s2_verification_tpu.checker.oracle import (
+        CheckOutcome,
+        CheckResult,
+        check,
+    )
+    from s2_verification_tpu.service import scheduler as sched_mod
+
+    real_cpu_check = sched_mod._cpu_check
+
+    def budget_always_expires(hist, budget):
+        if budget is None:  # the unbounded close: answer for real
+            return real_cpu_check(hist, None)
+        return CheckResult(outcome=CheckOutcome.UNKNOWN), "oracle"
+
+    monkeypatch.setattr(sched_mod, "_cpu_check", budget_always_expires)
+    monkeypatch.setattr(
+        sched_mod.Scheduler, "_escalate_device", lambda self, job: None
+    )
+
+    cfg = _daemon_cfg(
+        tmp_path, device="supervised", time_budget_s=1.0, unbounded_close=True
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        reply = client.submit(good_history(), client="wedge", no_viz=True)
+    assert reply["verdict"] == 0
+    assert reply["backend"].endswith("-unbounded")  # the CPU close decided
+    events = _events(tmp_path)
+    degrades = [e for e in events if e["ev"] == "degrade"]
+    assert len(degrades) == 1 and degrades[0]["to"] == "cpu"
+    stops = [e for e in events if e["ev"] == "serve_stop"]
+    assert stops and stops[0]["degraded"] == 1
+
+
+def test_supervise_wedged_child_degrades_to_none(tmp_path):
+    """Real supervision path: a child that never finishes an attempt
+    (timeout kills it mid-import) exhausts its restart budget and returns
+    None — the scheduler's degrade signal."""
+    from s2_verification_tpu.service.supervise import supervised_device_check
+
+    events = list(ev.iter_history(good_history()))
+    res = supervised_device_check(
+        events,
+        spool_dir=str(tmp_path / "spool"),
+        job_id=1,
+        attempt_timeout_s=0.2,  # killed long before jax can even import
+        max_restarts=0,
+        probe=False,
+    )
+    assert res is None
