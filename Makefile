@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs clean
 
 all: native
 
@@ -37,8 +37,15 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full:
+chaos-full: obs
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
+
+# Observability smoke (scripts/obs_check.py): boot verifyd with
+# --metrics-port + tracing + per-job profiling, drive a short load,
+# assert the /metrics exposition (required families, histogram
+# integrity), the stats-op merge, and the Perfetto-loadable trace.
+obs:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_check.py
 
 clean:
 	$(MAKE) -C native clean

@@ -65,6 +65,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import time
 from collections import deque
 from functools import partial
 from typing import NamedTuple
@@ -1441,6 +1442,7 @@ def check_device(
     start_frontier: int = 16,
     mesh=None,
     collect_stats: bool = False,
+    profile: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
     witness: bool = True,
@@ -1506,8 +1508,16 @@ def check_device(
     from the accept counts (:func:`_recover_witness_bounded`).  A
     capability past the reference, whose search is bounded by one
     process's memory.
+
+    ``profile=True`` (implies ``collect_stats``) records a timeline entry
+    per *compiled segment* (the driver's steering granularity — per-layer
+    scalars never leave the device) on ``stats.timeline``: cumulative
+    layer count, segment-max live rows, ops auto-closed, elapsed wall
+    seconds, and the stop code.  Spilled searches append one entry per
+    out-of-core layer.
     """
     del state_slots
+    collect_stats = collect_stats or profile
     # Whether the CALLER wants a witness; the working ``witness`` flag may
     # be dropped mid-run (cap, resume, spill), after which an OK verdict
     # falls back to counts-bounded recovery (_recover_witness_bounded).
@@ -1525,6 +1535,7 @@ def check_device(
             "(can_exact_pack); this history's counts space overflows u64"
         )
     stats = FrontierStats()
+    t_run0 = time.monotonic()
     if enc.total_remaining == 0:
         res = CheckResult(
             CheckOutcome.OK,
@@ -1642,6 +1653,8 @@ def check_device(
                 exact_pack=xp,
                 sort_dedup=sd,
                 pallas_fold=pf,
+                profile=profile,
+                profile_t0=t_run0,
             )
             if res.outcome != CheckOutcome.UNKNOWN:
                 with contextlib.suppress(FileNotFoundError):
@@ -1798,6 +1811,18 @@ def check_device(
         # candidate-set-width statistic is meaningful only for host engines.
         stats.auto_closed += int(seg_auto_closed)
         stats.expanded += int(seg_expanded)
+        if profile:
+            stats.timeline.append(
+                {
+                    "layer": stats.layers,
+                    "frontier": int(seg_max_live),
+                    "states": int(live),
+                    "auto_closed": int(seg_auto_closed),
+                    "elapsed_s": round(time.monotonic() - t_run0, 6),
+                    "stop": ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
+                    "bucket": f,
+                }
+            )
         deep_counts = deep_np
         if allow_prune:
             stats.pruned = stats.pruned or bool(seg_pruned)
@@ -1884,6 +1909,8 @@ def check_device(
                     exact_pack=xp,
                     sort_dedup=sd,
                     pallas_fold=pf,
+                    profile=profile,
+                    profile_t0=t_run0,
                 )
                 break
             stats.pruned = True
@@ -2360,6 +2387,8 @@ def _spill_search(
     exact_pack: bool = False,
     sort_dedup: bool = False,
     pallas_fold: bool = False,
+    profile: bool = False,
+    profile_t0: float | None = None,
 ) -> CheckResult:
     """Out-of-core exhaustive search: frontier in host RAM, slabs on device.
 
@@ -2391,6 +2420,23 @@ def _spill_search(
     matching snapshot is resumed from.
     """
     c = enc.num_chains
+    if profile_t0 is None:
+        profile_t0 = time.monotonic()
+
+    def _profile_entry(frontier_rows: int, states: int, stop: str) -> None:
+        if profile:
+            stats.timeline.append(
+                {
+                    "layer": stats.layers,
+                    "frontier": frontier_rows,
+                    "states": states,
+                    "auto_closed": stats.auto_closed,
+                    "elapsed_s": round(time.monotonic() - profile_t0, 6),
+                    "stop": stop,
+                    "spill": True,
+                }
+            )
+
     # A bucket that always fits one row's <= 2C children, whatever the
     # caller's max_frontier was.
     f_cap = max(f_cap, _round_pow2(4 * max(c, 1), 2))
@@ -2503,6 +2549,11 @@ def _spill_search(
             stats.max_frontier = max(stats.max_frontier, int(seg_live))
             stats.auto_closed += int(seg_ac)
             stats.expanded += int(seg_ex)
+            _profile_entry(
+                int(seg_live),
+                int(seg_live),
+                ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
+            )
             log.debug(
                 "spill in-core segment: stop=%s +%d layers",
                 ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
@@ -2728,6 +2779,7 @@ def _spill_search(
         host = _dedup_rows(np.concatenate(children))
         try_incore = True
         stats.max_frontier = max(stats.max_frontier, len(host))
+        _profile_entry(len(host), len(host), "STREAMED")
         log.debug(
             "spill layer %d: %d host rows", stats.layers, len(host)
         )
@@ -2749,6 +2801,7 @@ def check_device_auto(
     state_slots: int | None = None,
     mesh=None,
     collect_stats: bool = False,
+    profile: bool = False,
     checkpoint_path: str | None = None,
     checkpoint_every: int = 512,
     witness: bool = True,
@@ -2819,6 +2872,7 @@ def check_device_auto(
             beam=True,
             mesh=mesh,
             collect_stats=collect_stats,
+            profile=profile,
             checkpoint_path=(
                 f"{checkpoint_path}.beam" if checkpoint_path is not None else None
             ),
@@ -2846,6 +2900,7 @@ def check_device_auto(
         beam=False,
         mesh=mesh,
         collect_stats=collect_stats,
+        profile=profile,
         checkpoint_path=checkpoint_path,
         checkpoint_every=checkpoint_every,
         witness=witness,

@@ -35,8 +35,9 @@ precisely what makes adversarial histories CPU-intractable for Porcupine.
 
 from __future__ import annotations
 
+import time
 import zlib
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
 from .entries import History, Op
@@ -62,6 +63,12 @@ class FrontierStats:
     auto_closed: int = 0
     expanded: int = 0
     pruned: bool = False
+    #: per-layer profile entries (``profile=True`` runs only): each is
+    #: ``{"layer", "frontier", "states", "auto_closed", "elapsed_s"}`` —
+    #: host search appends one per BFS layer, the device search one per
+    #: compiled segment.  Plain dicts so ``dataclasses.asdict`` keeps the
+    #: whole object JSON/checkpoint-serializable.
+    timeline: list = field(default_factory=list)
 
 
 def _op_dead_forever(
@@ -90,6 +97,7 @@ def check_frontier(
     beam: bool = False,
     collect_stats: bool = False,
     witness: bool = True,
+    profile: bool = False,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
 
@@ -104,7 +112,14 @@ def check_frontier(
     an accepting path can be walked back into a concrete linearization —
     O(visited configs) extra memory (comparable to the DFS memo cache);
     pass ``witness=False`` for verdict-only runs.
+
+    ``profile=True`` (implies ``collect_stats``) additionally records a
+    per-layer timeline — frontier width, layer-max state-set size, ops
+    auto-closed in the layer, and elapsed wall seconds — on
+    ``stats.timeline``, the raw material for the viz frontier panel and
+    the daemon's per-job ``profile`` field.
     """
+    collect_stats = collect_stats or profile
     ops = history.ops
     chains = history.chains
     n_chains = len(chains)
@@ -224,11 +239,35 @@ def check_frontier(
                     changed = True
         return tuple(counts), states, closed_ops
 
+    # Per-layer profiling state: `entry` is the timeline dict under
+    # construction; _finish_layer() seals it at every layer exit point.
+    t_search = time.monotonic()
+    entry: dict | None = None
+    layer_states = 0
+    auto_before = 0
+
+    def _finish_layer() -> None:
+        if entry is not None:
+            entry["states"] = layer_states
+            entry["auto_closed"] = stats.auto_closed - auto_before
+            entry["elapsed_s"] = round(time.monotonic() - t_search, 6)
+
     layer = 0
     while True:
         layer += 1
         stats.layers = layer
         stats.max_frontier = max(stats.max_frontier, len(frontier))
+        layer_states = 0
+        if profile:
+            auto_before = stats.auto_closed
+            entry = {
+                "layer": layer,
+                "frontier": len(frontier),
+                "states": 0,
+                "auto_closed": 0,
+                "elapsed_s": 0.0,
+            }
+            stats.timeline.append(entry)
 
         closed: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
         #: post-close cfg -> (pre-close cfg, ops closed getting there)
@@ -247,6 +286,8 @@ def check_frontier(
                 deep_sum, deep_counts = csum, counts
             if accepting(counts):
                 stats.max_state_set = max(stats.max_state_set, len(states))
+                layer_states = max(layer_states, len(states))
+                _finish_layer()
                 if witness:
                     pre, closed_ops = close_link[(counts, states)]
                     order = walk(pre) + closed_ops + completion(counts)
@@ -273,6 +314,7 @@ def check_frontier(
                 if not new_states:
                     continue
                 stats.max_state_set = max(stats.max_state_set, len(new_states))
+                layer_states = max(layer_states, len(new_states))
                 child_counts = counts[:c] + (counts[c] + 1,) + counts[c + 1 :]
                 child = (child_counts, frozenset(new_states))
                 if child not in children:
@@ -281,6 +323,7 @@ def check_frontier(
                         parents[child] = (pre, tuple(closed_ops), chains[c][counts[c]])
 
         if not children:
+            _finish_layer()
             outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
             res = CheckResult(outcome, deepest=deepest_of(deep_counts))
             if collect_stats:
@@ -288,6 +331,7 @@ def check_frontier(
             return res
         if max_frontier is not None and len(children) > max_frontier:
             if not beam:
+                _finish_layer()
                 res = CheckResult(
                     CheckOutcome.UNKNOWN, deepest=deepest_of(deep_counts)
                 )
@@ -299,6 +343,7 @@ def check_frontier(
                 children, key=lambda cfg: (opens_taken(cfg[0]), _cfg_digest(cfg))
             )
             children = dict.fromkeys(ranked[:max_frontier])
+        _finish_layer()
         frontier = children
 
 
@@ -308,13 +353,16 @@ def check_frontier_auto(
     exhaustive_cap: int | None = None,
     collect_stats: bool = False,
     witness: bool = True,
+    profile: bool = False,
 ) -> CheckResult:
     """Beam-first frontier check with exhaustive escalation.
 
     Phase 1 runs a pruned (beam) search: fast, and an OK is conclusive.
     Only if the beam dead-ends after pruning does phase 2 re-run without a
     beam — the porcupine-equivalent exhaustive search (optionally bounded by
-    ``exhaustive_cap``, beyond which the result is UNKNOWN).
+    ``exhaustive_cap``, beyond which the result is UNKNOWN).  With
+    ``profile=True`` the returned stats/timeline describe the phase that
+    produced the verdict (the exhaustive pass, when it ran).
     """
     res = check_frontier(
         history,
@@ -322,6 +370,7 @@ def check_frontier_auto(
         beam=True,
         collect_stats=collect_stats,
         witness=witness,
+        profile=profile,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
@@ -330,4 +379,5 @@ def check_frontier_auto(
         max_frontier=exhaustive_cap,
         collect_stats=collect_stats,
         witness=witness,
+        profile=profile,
     )

@@ -18,6 +18,7 @@ import ctypes as ct
 import os
 import subprocess
 import threading
+import time as _time
 
 import numpy as np
 
@@ -104,6 +105,7 @@ def check_native(
     history: History,
     time_budget_s: float | None = None,
     _states_cap: int = 4096,
+    profile: bool = False,
 ) -> CheckResult:
     """Decide linearizability with the native engine.
 
@@ -111,14 +113,34 @@ def check_native(
     ``deepest`` linearized set on ILLEGAL/UNKNOWN.  ``_states_cap`` sizes
     the final-state output buffer (test hook; the wrapper retries with the
     exact size on overflow, so the default only affects allocation).
+
+    ``profile=True`` attaches per-phase wall attribution to the result as
+    ``res.profile`` — ``{"encode_s", "search_s", "steps", "cache_hits"}``
+    (the native search has no BFS layers; DFS steps and memo hits are its
+    shape signal).  ``search_s`` accumulates the rare overflow re-invoke.
     """
     lib = _load()
+    t_enc0 = _time.monotonic() if profile else 0.0
     enc = encode_history(history)
+    encode_s = (_time.monotonic() - t_enc0) if profile else 0.0
+
+    def _attach(res: CheckResult, search_s: float) -> CheckResult:
+        if profile:
+            res.profile = {  # type: ignore[attr-defined]
+                "encode_s": round(encode_s, 6),
+                "search_s": round(search_s, 6),
+                "steps": res.steps,
+                "cache_hits": res.cache_hits,
+            }
+        return res
     if enc.total_remaining == 0 and enc.num_ops == 0:
-        return CheckResult(
-            CheckOutcome.OK,
-            linearization=list(enc.forced_prefix),
-            final_states=sorted(enc.init_states),
+        return _attach(
+            CheckResult(
+                CheckOutcome.OK,
+                linearization=list(enc.forced_prefix),
+                final_states=sorted(enc.init_states),
+            ),
+            0.0,
         )
     n = enc.num_ops
 
@@ -183,6 +205,7 @@ def check_native(
             ct.byref(hits),
         )
 
+    t_search0 = _time.monotonic() if profile else 0.0
     rc = invoke(-1.0 if time_budget_s is None else time_budget_s)
     if rc == 0 and states_len.value > states_cap:
         # Final state set overflowed the buffer; re-run with room for all of
@@ -196,6 +219,7 @@ def check_native(
         st_tok = np.zeros(states_cap, np.int32)
         rc = invoke(-1.0)
         assert rc == 0 and states_len.value <= states_cap
+    search_s = (_time.monotonic() - t_search0) if profile else 0.0
 
     # Encoded op index → History.ops index (forced-prefix ops were peeled
     # off before encoding).
@@ -206,11 +230,14 @@ def check_native(
         deepest = list(enc.forced_prefix) + [
             keep_index[j] for j in order[: order_len.value]
         ]
-        return CheckResult(
-            outcome,
-            deepest=deepest,
-            steps=int(steps.value),
-            cache_hits=int(hits.value),
+        return _attach(
+            CheckResult(
+                outcome,
+                deepest=deepest,
+                steps=int(steps.value),
+                cache_hits=int(hits.value),
+            ),
+            search_s,
         )
 
     lin = list(enc.forced_prefix) + [
@@ -224,11 +251,14 @@ def check_native(
         )
         for i in range(states_len.value)
     ]
-    return CheckResult(
-        CheckOutcome.OK,
-        linearization=lin,
-        deepest=lin,
-        final_states=final,
-        steps=int(steps.value),
-        cache_hits=int(hits.value),
+    return _attach(
+        CheckResult(
+            CheckOutcome.OK,
+            linearization=lin,
+            deepest=lin,
+            final_states=final,
+            steps=int(steps.value),
+            cache_hits=int(hits.value),
+        ),
+        search_s,
     )

@@ -31,6 +31,8 @@ import sys
 import time
 from typing import Callable, Sequence
 
+from ..obs.trace import NULL_TRACER, Tracer
+
 __all__ = ["DriveOutcome", "drive", "default_probe_cmd"]
 
 #: Probe child source: init the backend honoring an explicit JAX_PLATFORMS
@@ -80,6 +82,8 @@ def drive(
     probe_interval_s: float = 180.0,
     max_probes: int = 120,
     log: Callable[[str], None] | None = None,
+    tracer: Tracer = NULL_TRACER,
+    trace_tid: int = 0,
 ) -> DriveOutcome:
     """Run ``cmd`` in a bounded child until ``done()`` reports a conclusive
     result, restarting through crashes and hangs.
@@ -91,6 +95,11 @@ def drive(
     ``probe_cmd`` (``None`` = no probing, e.g. host-backend tests) gates
     each relaunch on the backend answering again; the probe child is
     bounded too, because a dead tunnel hangs init.
+
+    ``tracer``/``trace_tid``: record one span per attempt (and one per
+    backend-probe wait) on the caller's trace track — verifyd passes its
+    job track here so supervised device escalations show their restart
+    structure in the trace export.
     """
     say = log or (lambda s: print(f"# resilient: {s}", file=sys.stderr, flush=True))
     attempts = 0
@@ -114,6 +123,7 @@ def drive(
         while attempts <= max_restarts:
             attempts += 1
             say(f"attempt {attempts}: {' '.join(cmd)}")
+            t_att = tracer.now()
             child = subprocess.Popen(list(cmd), start_new_session=True)
             current[0] = child
             try:
@@ -124,18 +134,41 @@ def drive(
                 say(f"attempt {attempts} hung >{attempt_timeout_s:.0f}s; killed")
             finally:
                 current[0] = None
-            if done():
+            finished = done()
+            tracer.add_span(
+                f"attempt {attempts}",
+                t_att,
+                tracer.now(),
+                tid=trace_tid,
+                cat="resilient",
+                args={"rc": last_rc, "conclusive": finished},
+            )
+            if finished:
                 return DriveOutcome(True, attempts, last_rc, "conclusive")
             if last_rc is not None:
                 say(f"attempt {attempts} exited rc={last_rc} without a result")
             if attempts > max_restarts:
                 break
-            if probe_cmd is not None and not _wait_for_backend(
-                probe_cmd, probe_timeout_s, probe_interval_s, max_probes, say
-            ):
-                return DriveOutcome(
-                    False, attempts, last_rc, "backend never answered between attempts"
+            if probe_cmd is not None:
+                t_probe = tracer.now()
+                alive = _wait_for_backend(
+                    probe_cmd, probe_timeout_s, probe_interval_s, max_probes, say
                 )
+                tracer.add_span(
+                    "backend_probe",
+                    t_probe,
+                    tracer.now(),
+                    tid=trace_tid,
+                    cat="resilient",
+                    args={"answered": alive},
+                )
+                if not alive:
+                    return DriveOutcome(
+                        False,
+                        attempts,
+                        last_rc,
+                        "backend never answered between attempts",
+                    )
         return DriveOutcome(False, attempts, last_rc, "restart budget exhausted")
     finally:
         if prev is not None:

@@ -90,15 +90,25 @@ def _read_events(path: str) -> list[ev.LabeledEvent]:
     return ev.read_history(path)
 
 
-def _cpu_check(hist: History, budget: float | None) -> CheckResult:
+def _cpu_check(
+    hist: History, budget: float | None, profile: bool = False
+) -> CheckResult:
     """Native engine when buildable, Python oracle otherwise."""
     from .checker.native import NativeUnavailable, check_native
 
     try:
-        return check_native(hist, time_budget_s=budget)
+        return check_native(hist, time_budget_s=budget, profile=profile)
     except NativeUnavailable as e:
         log.debug("native checker unavailable (%s); using the Python oracle", e)
         return check(hist, time_budget_s=budget)
+
+
+def _cpu(hist: History, budget: float | None, profile: bool) -> CheckResult:
+    # profile only when asked: test doubles for _cpu_check keep the plain
+    # (hist, budget) signature.
+    if profile:
+        return _cpu_check(hist, budget, profile=True)
+    return _cpu_check(hist, budget)
 
 
 def _run_backend(
@@ -108,6 +118,7 @@ def _run_backend(
     checkpoint: str | None = None,
     device_rows: int | None = None,
     collect_stats: bool = False,
+    profile: bool = False,
 ) -> CheckResult:
     # Budget 0 = run to completion, the reference's unbounded default
     # (CheckEventsVerbose timeout 0, main.go:606).
@@ -134,14 +145,18 @@ def _run_backend(
     if backend == "native":
         from .checker.native import check_native
 
-        return check_native(hist, time_budget_s=time_budget_s)
+        return check_native(hist, time_budget_s=time_budget_s, profile=profile)
     if backend == "frontier":
         from .checker.frontier import check_frontier_auto
 
-        return check_frontier_auto(hist, collect_stats=collect_stats)
+        return check_frontier_auto(
+            hist, collect_stats=collect_stats, profile=profile
+        )
     dev_kw = {} if device_rows is None else {"device_rows_cap": device_rows}
     if collect_stats:
         dev_kw["collect_stats"] = True
+    if profile:
+        dev_kw["profile"] = True
     if backend == "device":
         pin_platform()
         from .checker.device import check_device_auto
@@ -150,9 +165,9 @@ def _run_backend(
     if backend == "auto":
         if unbounded:
             # Never concede a decidable instance: CPU runs to completion.
-            return _cpu_check(hist, None)
+            return _cpu(hist, None, profile)
         budget = time_budget_s if time_budget_s is not None else 10.0
-        res = _cpu_check(hist, budget)
+        res = _cpu(hist, budget, profile)
         if res.outcome != CheckOutcome.UNKNOWN:
             return res
         log.info(
@@ -173,7 +188,7 @@ def _run_backend(
             "device search inconclusive; falling back to the unbounded "
             "CPU engine (no -time-budget was set)"
         )
-        return _cpu_check(hist, None)
+        return _cpu(hist, None, profile)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -212,6 +227,11 @@ def _cmd_check(args: argparse.Namespace) -> int:
             # halfway through the corpus.
             log.warning("-checkpoint is ignored in corpus mode")
             args.checkpoint = None
+        if args.profile:
+            # Same single-output constraint: one profile file cannot hold
+            # a corpus of timelines.
+            log.warning("--profile is ignored in corpus mode")
+            args.profile = None
         seen: set[int] = set()
         for path in corpus:
             # One unreadable/malformed file must not abort the corpus and
@@ -254,6 +274,7 @@ def _check_one(args: argparse.Namespace, file_path: str) -> int:
             checkpoint=args.checkpoint,
             device_rows=args.device_rows,
             collect_stats=args.stats,
+            profile=bool(args.profile),
         )
     except Exception as e:  # backend/environment failure, not a verdict
         from .checker.checkpoint import CheckpointError
@@ -310,6 +331,28 @@ def _check_one(args: argparse.Namespace, file_path: str) -> int:
             checked=checked,
         )
         log.info("wrote visualization to %s", path)
+
+    if args.profile:
+        # Search-shape profile: FrontierStats fields + per-layer timeline
+        # (+ native phase attribution), the same schema verifyd attaches
+        # to its `done` events — so offline and service profiling feed the
+        # same tooling.
+        import json as _json
+
+        from .service.scheduler import job_profile
+
+        prof = job_profile(res)
+        prof.update(
+            file=file_path,
+            outcome=res.outcome.value,
+            backend=args.backend,
+            wall_s=round(dt, 4),
+            ops=len(checked.ops),
+        )
+        with open(args.profile, "w", encoding="utf-8") as f:
+            _json.dump(prof, f, indent=2)
+            f.write("\n")
+        log.info("wrote search profile to %s", args.profile)
 
     if args.stats:
         # One machine-readable line on stdout — the per-check analog of
@@ -432,6 +475,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         secret=secret,
         state_dir=args.state_dir or None,
         fsync=args.fsync,
+        metrics_port=args.metrics_port,
+        trace_capacity=args.trace_capacity,
+        profile=args.profile,
     )
     daemon = Verifyd(cfg)
 
@@ -444,6 +490,49 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     for sig in (_signal.SIGINT, _signal.SIGTERM):
         _signal.signal(sig, _stop)
     return daemon.serve_forever()
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    from .service.client import (
+        VerifydClient,
+        VerifydError,
+        VerifydUnavailable,
+    )
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    try:
+        trace = client.trace()
+    except VerifydUnavailable as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydError as e:
+        log.error("trace fetch refused: %s", e)
+        return EXIT_PROTOCOL
+    except (OSError, TimeoutError) as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e)
+        return EXIT_UNAVAILABLE
+
+    import json as _json
+
+    text = _json.dumps(trace)
+    if args.out == "-":
+        print(text, flush=True)
+    else:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+            f.write("\n")
+        log.info(
+            "wrote %d trace events to %s (load in ui.perfetto.dev or "
+            "chrome://tracing)",
+            len(trace.get("traceEvents", [])),
+            args.out,
+        )
+    return 0
 
 
 def _cmd_submit(args: argparse.Namespace) -> int:
@@ -599,6 +688,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="print one machine-readable JSON line (verdict, wall-clock, "
         "search statistics) on stdout",
     )
+    c.add_argument(
+        "-profile",
+        "--profile",
+        default=None,
+        metavar="OUT.json",
+        help="write a search-shape profile JSON (FrontierStats + per-layer "
+        "timeline; native backend: per-phase wall attribution) — the same "
+        "schema verifyd attaches to its done events",
+    )
     c.set_defaults(fn=_cmd_check)
 
     g = sub.add_parser("collect", help="collect a history against the fake S2")
@@ -723,7 +821,57 @@ def build_parser() -> argparse.ArgumentParser:
         help="fsync every durable append (survives machine crashes, not "
         "just daemon death; slower)",
     )
+    s.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve Prometheus text metrics on http://127.0.0.1:PORT/metrics "
+        "(0 = ephemeral port, logged at startup; default: off)",
+    )
+    s.add_argument(
+        "--trace-capacity",
+        type=int,
+        default=8192,
+        metavar="SPANS",
+        help="in-memory span-ring capacity for the `trace` op (0 disables "
+        "tracing; default 8192)",
+    )
+    s.add_argument(
+        "-profile",
+        "--profile",
+        action="store_true",
+        help="attach a per-job search-shape profile (FrontierStats + "
+        "per-layer timeline) to every done event and submit reply",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
+
+    t = sub.add_parser(
+        "trace",
+        help="export a running verifyd's span ring as Chrome trace_event "
+        "JSON (loads in Perfetto / chrome://tracing)",
+    )
+    t.add_argument(
+        "-socket",
+        "--socket",
+        required=True,
+        help="the daemon's unix-socket path, or HOST:PORT for the "
+        "authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET)",
+    )
+    t.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    t.add_argument(
+        "-out",
+        "--out",
+        default="-",
+        help="output path for the trace JSON ('-' = stdout, the default)",
+    )
+    t.set_defaults(fn=_cmd_trace)
 
     u = sub.add_parser("submit", help="submit one history to a running verifyd")
     u.add_argument(

@@ -1,0 +1,25 @@
+"""Zero-dependency observability: spans, metrics, and a /metrics endpoint.
+
+The obs package is the instrumentation layer threaded through the checker
+engines and verifyd hot paths:
+
+- ``trace``   — a thread-safe Tracer recording nested spans into a bounded
+                ring, exportable as Chrome trace_event JSON (Perfetto).
+- ``metrics`` — counter / gauge / histogram registry rendering Prometheus
+                text exposition format 0.0.4.
+- ``httpd``   — stdlib-only HTTP listener serving GET /metrics.
+
+Everything here is stdlib-only by design: the daemon must stay deployable
+on a bare TPU host image with no pip access.
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .trace import Tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Tracer",
+]

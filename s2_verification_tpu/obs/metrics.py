@@ -1,0 +1,281 @@
+"""Counter / gauge / histogram registry with Prometheus text exposition.
+
+Stdlib-only reimplementation of the minimal prometheus_client surface the
+daemon needs.  Metrics are created once through the registry (idempotent
+per name) and updated from any thread; ``render()`` produces text
+exposition format 0.0.4, which Prometheus, VictoriaMetrics, and the
+Grafana Agent all scrape natively.
+
+Histograms use *fixed* buckets chosen at creation: cumulative ``le``
+bucket semantics (observe(v) lands in every bucket with v <= le, and
+``+Inf`` always equals ``_count``), matching the official client so
+``histogram_quantile()`` works unmodified in Grafana.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "LATENCY_BUCKETS",
+    "LAYER_BUCKETS",
+]
+
+#: Wall/queue latency buckets: sub-ms admission up to the 60s budget ceiling.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+#: Frontier-depth buckets: BFS layer counts are small integers, power-of-2.
+LAYER_BUCKETS: Tuple[float, ...] = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+def _labelstr(names: Sequence[str], values: Sequence[str], extra: str = "") -> str:
+    parts = [f'{n}="{_escape_label(str(v))}"' for n, v in zip(names, values)]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str] = ()) -> None:
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {tuple(labels)}"
+            )
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def header(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0)
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            for key in sorted(self._series):
+                out.append(
+                    f"{self.name}{_labelstr(self.labelnames, key)} "
+                    f"{_fmt(self._series[key])}"
+                )
+        return out
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                self.name + _labelstr(self.labelnames, k): v
+                for k, v in self._series.items()
+            }
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = value
+
+    def inc(self, amount: float = 1, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + amount
+
+    def dec(self, amount: float = 1, **labels: str) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0)
+
+    render = Counter.render
+    snapshot = Counter.snapshot
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str,
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+        labelnames: Sequence[str] = (),
+    ) -> None:
+        super().__init__(name, help, labelnames)
+        bs = sorted(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        self.buckets: Tuple[float, ...] = tuple(bs)
+
+    def observe(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                # [per-bucket counts..., +Inf implicit via count], sum, count
+                series = self._series[key] = [[0] * len(self.buckets), 0.0, 0]
+            idx = bisect_left(self.buckets, value)
+            if idx < len(self.buckets):
+                series[0][idx] += 1
+            series[1] += value
+            series[2] += 1
+
+    def counts(self, **labels: str) -> Tuple[List[int], float, int]:
+        """(cumulative bucket counts incl. +Inf, sum, count) for one series."""
+        with self._lock:
+            series = self._series.get(self._key(labels))
+            if series is None:
+                return [0] * (len(self.buckets) + 1), 0.0, 0
+            cum, acc = [], 0
+            for c in series[0]:
+                acc += c
+                cum.append(acc)
+            cum.append(series[2])
+            return cum, series[1], series[2]
+
+    def render(self) -> List[str]:
+        out = self.header()
+        with self._lock:
+            for key in sorted(self._series):
+                raw, total, count = self._series[key]
+                acc = 0
+                for le, c in zip(self.buckets, raw):
+                    acc += c
+                    extra = 'le="%s"' % _fmt(le)
+                    out.append(
+                        f"{self.name}_bucket"
+                        f"{_labelstr(self.labelnames, key, extra)} {acc}"
+                    )
+                inf = 'le="+Inf"'
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_labelstr(self.labelnames, key, inf)} {count}"
+                )
+                out.append(
+                    f"{self.name}_sum{_labelstr(self.labelnames, key)} {_fmt(total)}"
+                )
+                out.append(
+                    f"{self.name}_count{_labelstr(self.labelnames, key)} {count}"
+                )
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                self.name
+                + _labelstr(self.labelnames, k): {
+                    "count": v[2],
+                    "sum": round(v[1], 6),
+                }
+                for k, v in self._series.items()
+            }
+
+
+class MetricsRegistry:
+    """Idempotent metric factory + renderer (one per daemon)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(
+                        f"{name} already registered as {existing.kind}"
+                    )
+                return existing
+            metric = cls(name, help, **kw)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames=labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames=labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = LATENCY_BUCKETS,
+        labelnames: Sequence[str] = (),
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, help, buckets=buckets, labelnames=labelnames
+        )
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4 (trailing newline included)."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable flat view, merged into the daemon `stats` op."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
+        for m in metrics:
+            bucket = {
+                "counter": "counters",
+                "gauge": "gauges",
+                "histogram": "histograms",
+            }[m.kind]
+            out[bucket].update(m.snapshot())  # type: ignore[attr-defined]
+        return out

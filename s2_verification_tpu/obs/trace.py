@@ -1,0 +1,148 @@
+"""Bounded in-memory span ring, exportable as Chrome trace_event JSON.
+
+The Tracer records *completed* spans ("X" phase events in the trace_event
+format) into a ``collections.deque(maxlen=capacity)``: recording is O(1),
+never allocates beyond the ring, and is safe from any thread.  Timestamps
+are ``time.monotonic()`` seconds converted to microseconds relative to the
+tracer's construction instant, so spans recorded from different threads
+share one coherent timeline.
+
+Tracks: the ``tid`` field is a *virtual* track id, not an OS thread id.
+verifyd gives every job its own track (``tid = job id``) so the nested
+``admit -> queue_wait -> search -> render`` lifecycle of one job reads as
+one lane in Perfetto; track 0 is the acceptor ("admission") lane.
+
+The export is a single JSON object ``{"traceEvents": [...],
+"displayTimeUnit": "ms"}`` — the JSON Object Format, which both
+``chrome://tracing`` and https://ui.perfetto.dev load directly.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = ["Tracer", "NULL_TRACER"]
+
+# A track-name metadata set larger than this is reset wholesale: track ids
+# are job ids (unbounded over a daemon's life) and the set exists only to
+# dedupe "M" events, so losing it merely re-emits a name.
+_MAX_NAMED_TRACKS = 65536
+
+
+class Tracer:
+    """Thread-safe bounded span recorder with Chrome trace_event export."""
+
+    def __init__(self, capacity: int = 8192) -> None:
+        self.capacity = max(0, int(capacity))
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity or 1)
+        self._base = time.monotonic()
+        self._pid = os.getpid()
+        self._named: set = set()
+        self._dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def now(self) -> float:
+        """A timestamp suitable for add_span (monotonic seconds)."""
+        return time.monotonic()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def add_span(
+        self,
+        name: str,
+        t0: float,
+        t1: float,
+        *,
+        tid: int = 0,
+        cat: str = "verifyd",
+        args: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Record a completed span [t0, t1] (``time.monotonic()`` seconds)."""
+        if not self.enabled:
+            return
+        ev: Dict[str, Any] = {
+            "name": name,
+            "ph": "X",
+            "ts": round((t0 - self._base) * 1e6, 3),
+            "dur": round(max(0.0, t1 - t0) * 1e6, 3),
+            "pid": self._pid,
+            "tid": int(tid),
+            "cat": cat,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self._dropped += 1
+            self._ring.append(ev)
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        *,
+        tid: int = 0,
+        cat: str = "verifyd",
+        args: Optional[Dict[str, Any]] = None,
+    ) -> Iterator[None]:
+        """Context manager recording the enclosed block as one span."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.add_span(name, t0, time.monotonic(), tid=tid, cat=cat, args=args)
+
+    def name_track(self, tid: int, name: str) -> None:
+        """Label a virtual track (emits one thread_name "M" event per tid)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            if tid in self._named:
+                return
+            if len(self._named) >= _MAX_NAMED_TRACKS:
+                self._named.clear()
+            self._named.add(tid)
+            self._ring.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "ts": 0,
+                    "pid": self._pid,
+                    "tid": int(tid),
+                    "args": {"name": name},
+                }
+            )
+
+    def export(self) -> Dict[str, Any]:
+        """Snapshot the ring as a loadable trace_event JSON object."""
+        with self._lock:
+            events: List[Dict[str, Any]] = list(self._ring)
+            dropped = self._dropped
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "s2-verification-tpu",
+                "span_capacity": self.capacity,
+                "spans_dropped": dropped,
+            },
+        }
+
+
+#: Shared disabled tracer: every record path is a cheap no-op.  Components
+#: take ``tracer=NULL_TRACER`` defaults so call sites never None-check.
+NULL_TRACER = Tracer(0)

@@ -209,6 +209,10 @@ class VerifydClient:
     def stats(self, timeout: float | None = 10.0) -> dict:
         return self._call({"op": "stats"}, timeout=timeout)
 
+    def trace(self, timeout: float | None = 10.0) -> dict:
+        """Fetch the daemon's span ring as Chrome trace_event JSON."""
+        return self._call({"op": "trace"}, timeout=timeout)
+
     def shutdown(self, timeout: float | None = 10.0) -> dict:
         return self._call({"op": "shutdown"}, timeout=timeout)
 

@@ -36,6 +36,9 @@ from dataclasses import dataclass, field
 
 from .. import version as _version
 from ..checker.entries import prepare
+from ..obs.httpd import MetricsServer
+from ..obs.metrics import MetricsRegistry
+from ..obs.trace import Tracer
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
 from .journal import JobJournal
@@ -100,6 +103,15 @@ class VerifydConfig:
     #: fsync every durable append (survives machine crash, not just
     #: process death); off by default — SIGKILL safety needs only flush
     fsync: bool = False
+    #: Prometheus /metrics HTTP listener port; None = no listener, 0 =
+    #: ephemeral (bound port on :attr:`Verifyd.metrics_port`)
+    metrics_port: int | None = None
+    #: span-ring capacity for the in-process tracer (`trace` op / CLI
+    #: export); 0 disables tracing entirely
+    trace_capacity: int = 8192
+    #: attach per-job search profiles (FrontierStats timeline, native
+    #: phase attribution) to `done` events and submit replies
+    profile: bool = False
     extra: dict = field(default_factory=dict)
 
 
@@ -120,7 +132,10 @@ class Verifyd:
         elif config.stats_log:
             self._stats_file = open(config.stats_log, "a", encoding="utf-8")
             sink = self._stats_file
-        self.stats = ServiceStats(sink)
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer(config.trace_capacity)
+        self.tracer.name_track(0, "admission")
+        self.stats = ServiceStats(sink, registry=self.registry)
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
         )
@@ -158,6 +173,8 @@ class Verifyd:
             attempt_timeout_s=config.attempt_timeout_s,
             max_restarts=config.max_restarts,
             journal=self.journal,
+            tracer=self.tracer,
+            profile=config.profile,
         )
         self._job_ids = itertools.count(1)
         self._thread: threading.Thread | None = None
@@ -168,10 +185,18 @@ class Verifyd:
         self._startup_error: BaseException | None = None
         #: bound port of the TCP listener (set before __enter__ returns)
         self.tcp_port: int | None = None
+        #: bound port of the /metrics listener (set in __enter__)
+        self.metrics_port: int | None = None
+        self._metrics_server: MetricsServer | None = None
 
     # -- lifecycle ----------------------------------------------------------
 
     def __enter__(self) -> "Verifyd":
+        if self.cfg.metrics_port is not None:
+            self._metrics_server = MetricsServer(
+                self.registry, self.cfg.metrics_port
+            )
+            self.metrics_port = self._metrics_server.port
         self._recover_orphans()
         self.scheduler.start(self.cfg.workers)
         self.stats.emit(
@@ -201,6 +226,8 @@ class Verifyd:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.scheduler.stop()
+        if self._metrics_server is not None:
+            self._metrics_server.close()
         self.stats.emit("serve_stop", **self.stats.snapshot())
         self.cache.close()
         if self.journal is not None:
@@ -248,6 +275,7 @@ class Verifyd:
                 priority=job.priority,
                 history=text,
             )
+            job.enqueued_at = self.tracer.now()
             try:
                 self.queue.put(job)
             except QueueFull:
@@ -438,7 +466,11 @@ class Verifyd:
                 snap = self.stats.snapshot()
                 snap["queue_depth_now"] = len(self.queue)
                 snap["cache_entries"] = len(self.cache)
+                if self.metrics_port is not None:
+                    snap["metrics_port"] = self.metrics_port
                 return ok(snap)
+            if op == "trace":
+                return ok(self.tracer.export())
             if op == "shutdown":
                 self.request_stop()
                 return ok({"stopping": True})
@@ -450,6 +482,7 @@ class Verifyd:
             return err(ERR_INTERNAL, repr(e))
 
     async def _submit(self, req: dict) -> dict:
+        t_recv = self.tracer.now()
         text = req.get("history")
         if not isinstance(text, str) or not text.strip():
             self.stats.emit("decode_error", reason="missing history")
@@ -461,18 +494,31 @@ class Verifyd:
             return err(ERR_DECODE, f"priority must be an int, got {req.get('priority')!r}")
         no_viz = bool(req.get("no_viz", self.cfg.no_viz))
 
+        t_prep0 = self.tracer.now()
         try:
             events = list(ev.iter_history(text))
             hist = prepare(events, elide_trivial=True)
         except (ev.DecodeError, ValueError) as e:
             self.stats.emit("decode_error", client=client, reason=str(e)[:200])
             return err(ERR_DECODE, str(e))
+        t_prep1 = self.tracer.now()
 
         fingerprint = history_fingerprint(hist)
         cached = self.cache.get(fingerprint)
         if cached is not None:
             self.stats.emit(
-                "cache_hit", stage="admission", client=client, fingerprint=fingerprint
+                "cache_hit",
+                stage="admission",
+                client=client,
+                fingerprint=fingerprint,
+                queue_wait_s=0.0,
+            )
+            self.tracer.add_span(
+                "admit",
+                t_recv,
+                self.tracer.now(),
+                tid=0,
+                args={"client": client, "cached": True},
             )
             cached.update(cached=True, queue_wait_s=0.0)
             return ok(cached)
@@ -531,6 +577,7 @@ class Verifyd:
             if self.journal is not None:
                 self.journal.reject(job.id)
             return err(ERR_SHUTTING_DOWN, str(e))
+        job.enqueued_at = self.tracer.now()
         self.stats.emit(
             "admit",
             job=job.id,
@@ -539,4 +586,15 @@ class Verifyd:
             shape=job.shape,
             depth=depth,
         )
+        self.stats.set_queue_depth(depth)
+        if self.tracer.enabled:
+            self.tracer.name_track(job.id, f"job {job.id} ({client})")
+            self.tracer.add_span("prepare", t_prep0, t_prep1, tid=job.id)
+            self.tracer.add_span(
+                "admit",
+                t_recv,
+                job.enqueued_at,
+                tid=job.id,
+                args={"client": client, "shape": job.shape, "depth": depth},
+            )
         return await fut

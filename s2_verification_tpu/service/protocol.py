@@ -20,6 +20,9 @@ Ops:
                 (``verdict`` = the CLI exit code 0/1/2, ``outcome``), the
                 HTML artifact path, the backend that decided, queue wait,
                 and ``cached`` (answered from the verdict cache).
+``trace``     → ``{"ok": {"traceEvents": [...], ...}}`` — the daemon's
+                in-memory span ring in Chrome trace_event JSON (Object
+                Format); loads directly in Perfetto / chrome://tracing.
 ``shutdown``  → acks, then stops the daemon.
 
 Frame bounds: the daemon reads at most ``MAX_FRAME_BYTES`` per frame

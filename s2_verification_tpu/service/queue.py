@@ -49,6 +49,9 @@ class Job:
     hist: Any  # prepared History (elide_trivial=True)
     no_viz: bool = False
     submitted_at: float = field(default_factory=time.monotonic)
+    #: monotonic instant the job entered the admission queue (0.0 =
+    #: unknown; queue-wait accounting falls back to ``submitted_at``)
+    enqueued_at: float = 0.0
     #: called exactly once with the reply dict (thread-safe trampoline
     #: into the daemon's event loop)
     resolve: Callable[[dict], None] = lambda _reply: None

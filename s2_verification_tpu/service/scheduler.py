@@ -29,6 +29,7 @@ from ..checker.entries import History, prepare
 from ..checker.oracle import CheckOutcome, CheckResult, check
 from ..models.encode import _bucket_chains, _bucket_len, round_pow2
 from ..models.stream import APPEND
+from ..obs.trace import NULL_TRACER, Tracer
 from .protocol import VERDICT_EXIT, err, ok
 from .queue import AdmissionQueue, Job
 from .stats import ServiceStats
@@ -52,15 +53,41 @@ def shape_key(hist: History) -> str:
     )
 
 
-def _cpu_check(hist: History, budget: float | None) -> tuple[CheckResult, str]:
+def _cpu_check(
+    hist: History, budget: float | None, profile: bool = False
+) -> tuple[CheckResult, str]:
     """Native engine when buildable, Python oracle otherwise (cli.py)."""
     from ..checker.native import NativeUnavailable, check_native
 
     try:
-        return check_native(hist, time_budget_s=budget), "native"
+        return check_native(hist, time_budget_s=budget, profile=profile), "native"
     except NativeUnavailable as e:
         log.debug("native checker unavailable (%s); using the Python oracle", e)
         return check(hist, time_budget_s=budget), "oracle"
+
+
+def job_profile(res: CheckResult) -> dict:
+    """Per-job search profile for `done` events / replies: generic result
+    counters, plus whatever the deciding engine attributed — FrontierStats
+    (+ per-layer timeline when the engine ran with profile=True) from the
+    frontier/device searches, phase timings from the native checker."""
+    out: dict = {"steps": res.steps, "cache_hits": res.cache_hits}
+    st = getattr(res, "stats", None)
+    if st is not None:
+        out.update(
+            layers=st.layers,
+            max_frontier=st.max_frontier,
+            max_state_set=st.max_state_set,
+            auto_closed=st.auto_closed,
+            expanded=st.expanded,
+            pruned=st.pruned,
+        )
+        if getattr(st, "timeline", None):
+            out["timeline"] = st.timeline
+    phases = getattr(res, "profile", None)
+    if isinstance(phases, dict):
+        out["phases"] = phases
+    return out
 
 
 class Scheduler:
@@ -80,6 +107,8 @@ class Scheduler:
         attempt_timeout_s: float = 900.0,
         max_restarts: int = 2,
         journal=None,
+        tracer: Tracer = NULL_TRACER,
+        profile: bool = False,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -98,6 +127,8 @@ class Scheduler:
         self.attempt_timeout_s = attempt_timeout_s
         self.max_restarts = max_restarts
         self.journal = journal
+        self.tracer = tracer
+        self.profile = profile
         self._threads: list[threading.Thread] = []
         self._stopping = False
 
@@ -126,6 +157,7 @@ class Scheduler:
                 if self.queue.closed:
                     return
                 continue
+            self.stats.set_queue_depth(len(self.queue))
             for job in batch:
                 try:
                     reply = self._run_job(job)
@@ -135,6 +167,9 @@ class Scheduler:
                     # Close the journal record even on failure: a poison
                     # job must not re-run on every restart forever.
                     self._mark_done(job, verdict=None, outcome="error")
+                    # Balance the `start` event so in-flight accounting
+                    # (active-jobs gauge, retry-after hint) can't leak.
+                    self.stats.emit("job_error", job=job.id, reason=repr(e)[:200])
                 job.resolve(reply)
 
     def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
@@ -151,14 +186,19 @@ class Scheduler:
             log.exception("job %d: journal done-mark failed", job.id)
 
     def _run_job(self, job: Job) -> dict:
-        queue_wait = time.monotonic() - job.submitted_at
+        t_pick = time.monotonic()
+        queue_wait = t_pick - (job.enqueued_at or job.submitted_at)
         # Duplicate admitted while its twin was still in flight: answer
         # from the verdict cache at execution time too.
         cached = self.cache.get(job.fingerprint)
         if cached is not None:
             cached.update(cached=True, job=job.id, queue_wait_s=round(queue_wait, 4))
             self.stats.emit(
-                "cache_hit", stage="execute", job=job.id, client=job.client
+                "cache_hit",
+                stage="execute",
+                job=job.id,
+                client=job.client,
+                queue_wait_s=round(queue_wait, 4),
             )
             self._mark_done(
                 job,
@@ -176,14 +216,24 @@ class Scheduler:
             shape_warm=warm,
             queue_wait_s=round(queue_wait, 4),
         )
+        if job.enqueued_at:
+            self.tracer.add_span("queue_wait", job.enqueued_at, t_pick, tid=job.id)
         t0 = time.monotonic()
         res, backend = self._portfolio(job)
         wall = time.monotonic() - t0
+        self.tracer.add_span(
+            "search",
+            t0,
+            t0 + wall,
+            tid=job.id,
+            args={"backend": backend, "outcome": res.outcome.value},
+        )
 
         artifact = None
         if not job.no_viz:
             try:
-                artifact = self._write_artifact(job, res)
+                with self.tracer.span("render", tid=job.id):
+                    artifact = self._write_artifact(job, res)
             except Exception:
                 log.exception("job %d: artifact write failed", job.id)
 
@@ -198,6 +248,9 @@ class Scheduler:
             "artifact": artifact,
             "cached": False,
         }
+        profile = job_profile(res) if self.profile else None
+        if profile is not None:
+            payload["profile"] = profile
         # Inconclusive verdicts are not cached: a resubmission may get a
         # healthier device or a bigger budget and deserves a fresh run.
         if res.outcome != CheckOutcome.UNKNOWN:
@@ -207,8 +260,7 @@ class Scheduler:
         self._mark_done(
             job, verdict=payload["verdict"], outcome=res.outcome.value
         )
-        self.stats.emit(
-            "done",
+        done_fields = dict(
             job=job.id,
             client=job.client,
             backend=backend,
@@ -218,6 +270,9 @@ class Scheduler:
             shape=job.shape,
             shape_warm=warm,
         )
+        if profile is not None:
+            done_fields["profile"] = profile
+        self.stats.emit("done", **done_fields)
         out = dict(payload)
         out.update(job=job.id, queue_wait_s=round(queue_wait, 4))
         return ok(out)
@@ -229,21 +284,48 @@ class Scheduler:
         if budget is not None and budget <= 0:
             # Budget 0 = run to completion on CPU (the reference's
             # unbounded default), mirroring cli._run_backend.
-            res, engine = _cpu_check(job.hist, None)
+            res, engine = self._traced_cpu(job, None)
             return res, f"{engine}-unbounded"
         budget = budget if budget is not None else 10.0
-        res, engine = _cpu_check(job.hist, budget)
+        res, engine = self._traced_cpu(job, budget)
         if res.outcome != CheckOutcome.UNKNOWN:
             return res, engine
         if self.device != "off":
+            t_dev = time.monotonic()
             dres = self._escalate_device(job)
+            self.tracer.add_span(
+                f"device[{self.device}]",
+                t_dev,
+                time.monotonic(),
+                tid=job.id,
+                args={"degraded": dres is None},
+            )
             if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
                 return dres, f"device-{self.device}"
             if dres is None:
                 self.stats.emit("degrade", job=job.id, to="cpu")
         if self.unbounded_close:
-            res, engine = _cpu_check(job.hist, None)
+            res, engine = self._traced_cpu(job, None)
             return res, f"{engine}-unbounded"
+        return res, engine
+
+    def _traced_cpu(
+        self, job: Job, budget: float | None
+    ) -> tuple[CheckResult, str]:
+        t0 = time.monotonic()
+        # profile only when asked: test doubles for _cpu_check keep the
+        # plain (hist, budget) signature.
+        if self.profile:
+            res, engine = _cpu_check(job.hist, budget, profile=True)
+        else:
+            res, engine = _cpu_check(job.hist, budget)
+        self.tracer.add_span(
+            f"cpu[{engine}]",
+            t0,
+            time.monotonic(),
+            tid=job.id,
+            args={"budget_s": budget, "outcome": res.outcome.value},
+        )
         return res, engine
 
     def _escalate_device(self, job: Job) -> CheckResult | None:
@@ -254,6 +336,8 @@ class Scheduler:
 
             pin_platform()
             kw = {} if self.device_rows is None else {"device_rows_cap": self.device_rows}
+            if self.profile:
+                kw["profile"] = True
             return check_device_auto(job.hist, **kw)
         from .supervise import supervised_device_check
 
@@ -265,6 +349,7 @@ class Scheduler:
             max_restarts=self.max_restarts,
             device_rows=self.device_rows,
             log=lambda s: log.info("job %d supervise: %s", job.id, s),
+            tracer=self.tracer,
         )
 
     # -- artifact -----------------------------------------------------------

@@ -1,4 +1,4 @@
-"""Structured per-job service events + aggregate counters.
+"""Structured per-job service events + aggregate counters + metrics.
 
 Every notable daemon event becomes one JSON line on the configured sink
 (a file-like object; ``None`` silences the stream but keeps counters):
@@ -8,19 +8,24 @@ Every notable daemon event becomes one JSON line on the configured sink
      "verdict": 0, "shape": "64x5x8", "shape_warm": true}
 
 Event names: ``serve_start``, ``admit``, ``reject``, ``cache_hit``,
-``start``, ``done``, ``decode_error``, ``degrade`` (supervised device job
+``start``, ``done``, ``job_error`` (worker raised; job answered with an
+internal error), ``decode_error``, ``degrade`` (supervised device job
 fell back to CPU), ``serve_stop``; durability and remote-transport
 events: ``cache_loaded`` (persistent verdict segments replayed at boot),
 ``orphan`` (journal replay re-admitted an accepted-but-unanswered job),
 ``orphan_dropped`` / ``orphan_invalid`` (reported, not silently lost),
 ``auth_reject`` (TCP frame failed HMAC verification — rejected before
-admission), ``frame_error`` (oversized or malformed frame).
+admission), ``frame_error`` (oversized or malformed frame),
+``stats_sink_lost`` (the event sink broke twice; counters survive).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
 
 Counters aggregate the same stream for the ``stats`` protocol op and for
-the backpressure retry-after hint (average decided-job wall time).
+the backpressure retry-after hint (average decided-job wall time).  The
+same hooks also drive a Prometheus :class:`~..obs.MetricsRegistry`
+(scraped via ``serve --metrics-port``), so the JSONL stream, the ``stats``
+op, and /metrics can never disagree about what happened.
 """
 
 from __future__ import annotations
@@ -28,13 +33,21 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import IO
+from typing import IO, Optional
+
+from ..obs.metrics import LATENCY_BUCKETS, LAYER_BUCKETS, MetricsRegistry
 
 __all__ = ["ServiceStats"]
 
+_VERDICT_LABEL = {0: "ok", 1: "illegal", 2: "unknown"}
+
 
 class ServiceStats:
-    def __init__(self, sink: IO[str] | None = None) -> None:
+    def __init__(
+        self,
+        sink: IO[str] | None = None,
+        registry: Optional[MetricsRegistry] = None,
+    ) -> None:
         self._sink = sink
         self._lock = threading.Lock()
         self._t0 = time.time()
@@ -53,9 +66,75 @@ class ServiceStats:
             "frame_errors": 0,
             "orphans_recovered": 0,
             "cache_loaded": 0,
+            "job_errors": 0,
+            "stats_sink_lost": 0,
         }
         self._wall_total_s = 0.0
+        self._active = 0  # jobs handed to a worker, not yet answered
         self._shapes_seen: set[str] = set()
+
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self._m_submitted = r.counter(
+            "verifyd_jobs_submitted_total", "Submit requests received (any outcome)"
+        )
+        self._m_rejected = r.counter(
+            "verifyd_jobs_rejected_total", "Submits rejected by admission control"
+        )
+        self._m_completed = r.counter(
+            "verifyd_jobs_completed_total",
+            "Jobs answered with a verdict",
+            labelnames=("verdict",),
+        )
+        self._m_cache_hits = r.counter(
+            "verifyd_cache_hits_total", "Verdicts answered from the cache"
+        )
+        self._m_decode_errors = r.counter(
+            "verifyd_decode_errors_total", "Submits with undecodable histories"
+        )
+        self._m_degraded = r.counter(
+            "verifyd_degraded_total", "Device escalations that fell back to CPU"
+        )
+        self._m_job_errors = r.counter(
+            "verifyd_job_errors_total", "Jobs answered with an internal error"
+        )
+        self._m_auth_rejects = r.counter(
+            "verifyd_auth_rejects_total", "TCP frames failing HMAC verification"
+        )
+        self._m_frame_errors = r.counter(
+            "verifyd_frame_errors_total", "Oversized or malformed frames"
+        )
+        self._m_orphans = r.counter(
+            "verifyd_orphans_recovered_total", "Journal orphans re-admitted at boot"
+        )
+        self._m_cache_loaded = r.counter(
+            "verifyd_cache_loaded_total", "Persisted verdicts replayed at boot"
+        )
+        self._m_sink_lost = r.counter(
+            "verifyd_stats_sink_lost_total", "Stats sinks dropped after a retry"
+        )
+        self._m_active = r.gauge(
+            "verifyd_active_jobs", "Jobs currently executing on a worker"
+        )
+        self._m_queue_depth = r.gauge(
+            "verifyd_queue_depth", "Jobs waiting in the admission queue"
+        )
+        self._m_queue_wait = r.histogram(
+            "verifyd_queue_wait_seconds",
+            "Admission-to-worker-pickup latency",
+            buckets=LATENCY_BUCKETS,
+        )
+        self._m_wall = r.histogram(
+            "verifyd_wall_seconds",
+            "Verification wall time by deciding backend",
+            buckets=LATENCY_BUCKETS,
+            labelnames=("backend",),
+        )
+        self._m_layers = r.histogram(
+            "verifyd_frontier_layers",
+            "BFS layers searched per profiled job",
+            buckets=LAYER_BUCKETS,
+        )
 
     # -- event stream -------------------------------------------------------
 
@@ -65,44 +144,102 @@ class ServiceStats:
             if self._sink is not None:
                 line = {"ev": event, "t": round(time.time(), 3)}
                 line.update(fields)
-                try:
-                    self._sink.write(json.dumps(line, separators=(",", ":")) + "\n")
-                    self._sink.flush()
-                except (OSError, ValueError):
-                    # A closed/broken stats sink must never take a job down.
-                    self._sink = None
+                payload = json.dumps(line, separators=(",", ":")) + "\n"
+                # A broken stats sink must never take a job down — but a
+                # single transient OSError (EINTR, brief ENOSPC) must not
+                # silence the stream forever either: retry once, then drop
+                # the sink with an accounted stats_sink_lost increment.
+                for attempt in (0, 1):
+                    try:
+                        self._sink.write(payload)
+                        self._sink.flush()
+                        break
+                    except ValueError:
+                        # Closed file object: no point retrying.
+                        self._drop_sink()
+                        break
+                    except OSError:
+                        if attempt:
+                            self._drop_sink()
+        # end critical section
+
+    def _drop_sink(self) -> None:
+        # Caller holds self._lock.
+        self._sink = None
+        self._counters["stats_sink_lost"] += 1
+        self._m_sink_lost.inc()
 
     def _count(self, event: str, fields: dict) -> None:
         if event == "admit":
             self._counters["submitted"] += 1
             self._counters["admitted"] += 1
+            self._m_submitted.inc()
         elif event == "reject":
             self._counters["submitted"] += 1
             self._counters["rejected"] += 1
+            self._m_submitted.inc()
+            self._m_rejected.inc()
         elif event == "cache_hit":
             self._counters["submitted"] += 1
             self._counters["cache_hits"] += 1
+            self._m_submitted.inc()
+            self._m_cache_hits.inc()
+            if "queue_wait_s" in fields:
+                self._m_queue_wait.observe(float(fields["queue_wait_s"]))
         elif event == "decode_error":
             self._counters["submitted"] += 1
             self._counters["decode_errors"] += 1
+            self._m_submitted.inc()
+            self._m_decode_errors.inc()
         elif event == "degrade":
             self._counters["degraded"] += 1
+            self._m_degraded.inc()
         elif event == "auth_reject":
             self._counters["auth_rejects"] += 1
+            self._m_auth_rejects.inc()
         elif event == "frame_error":
             self._counters["frame_errors"] += 1
+            self._m_frame_errors.inc()
         elif event == "orphan":
             self._counters["orphans_recovered"] += 1
+            self._m_orphans.inc()
         elif event == "cache_loaded":
-            self._counters["cache_loaded"] = int(fields.get("entries", 0))
+            # Additive: one boot can replay several segments (and a long
+            # daemon life can reload more than once); each event reports
+            # the entries *it* replayed.
+            n = int(fields.get("entries", 0))
+            self._counters["cache_loaded"] += n
+            self._m_cache_loaded.inc(n)
+        elif event == "start":
+            self._active += 1
+            self._m_active.set(self._active)
+            if "queue_wait_s" in fields:
+                self._m_queue_wait.observe(float(fields["queue_wait_s"]))
+        elif event == "job_error":
+            self._counters["job_errors"] += 1
+            self._active = max(0, self._active - 1)
+            self._m_job_errors.inc()
+            self._m_active.set(self._active)
         elif event == "done":
             self._counters["completed"] += 1
-            self._wall_total_s += float(fields.get("wall_s", 0.0))
-            v = {0: "verdict_ok", 1: "verdict_illegal", 2: "verdict_unknown"}.get(
-                fields.get("verdict")
-            )
-            if v is not None:
-                self._counters[v] += 1
+            self._active = max(0, self._active - 1)
+            self._m_active.set(self._active)
+            wall = float(fields.get("wall_s", 0.0))
+            self._wall_total_s += wall
+            v = fields.get("verdict")
+            name = {0: "verdict_ok", 1: "verdict_illegal", 2: "verdict_unknown"}.get(v)
+            if name is not None:
+                self._counters[name] += 1
+            self._m_completed.inc(verdict=_VERDICT_LABEL.get(v, "unknown"))
+            self._m_wall.observe(wall, backend=str(fields.get("backend", "unknown")))
+            profile = fields.get("profile")
+            if isinstance(profile, dict) and "layers" in profile:
+                self._m_layers.observe(float(profile["layers"]))
+
+    def set_queue_depth(self, depth: int) -> None:
+        """Point-in-time admission-queue depth (daemon after put, workers
+        after a batch pull)."""
+        self._m_queue_depth.set(depth)
 
     # -- shape warmth -------------------------------------------------------
 
@@ -121,15 +258,21 @@ class ServiceStats:
             snap = dict(self._counters)
             snap["uptime_s"] = round(time.time() - self._t0, 3)
             snap["shapes_run"] = len(self._shapes_seen)
+            snap["active"] = self._active
             done = self._counters["completed"]
             snap["avg_wall_s"] = round(self._wall_total_s / done, 4) if done else 0.0
-            return snap
+        snap["metrics"] = self.registry.snapshot()
+        return snap
 
     def retry_after_hint(self, queue_depth: int) -> float:
         """Backpressure hint: roughly how long until the queue has room —
-        depth × average decided-job wall time, clamped to [0.5, 30] s (a
-        cold daemon has no average yet; never tell a client "0")."""
+        (queued + in-flight jobs) × average decided-job wall time, clamped
+        to [0.5, 30] s (a cold daemon has no average yet; never tell a
+        client "0").  In-flight jobs count because under full concurrency
+        a deep queue behind busy workers drains no faster than the
+        workers finish."""
         with self._lock:
             done = self._counters["completed"]
             avg = (self._wall_total_s / done) if done else 1.0
-        return round(min(30.0, max(0.5, queue_depth * avg)), 2)
+            pending = queue_depth + self._active
+        return round(min(30.0, max(0.5, pending * avg)), 2)

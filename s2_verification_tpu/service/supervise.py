@@ -60,6 +60,7 @@ def supervised_device_check(
     device_rows: int | None = None,
     probe: bool | None = None,
     log=None,
+    tracer=None,
 ) -> CheckResult | None:
     """Run the device search for ``events`` under supervision.
 
@@ -67,9 +68,12 @@ def supervised_device_check(
     one (restart budget exhausted, backend dead) — the caller's signal to
     degrade the job to CPU.  ``probe`` gates between-attempt backend
     probing; default: only when the environment is not pinned to CPU
-    (probing a CPU "backend" is pointless and slow).
+    (probing a CPU "backend" is pointless and slow).  ``tracer`` (a
+    :class:`~..obs.Tracer`) records the driver's attempt/probe spans on
+    the job's trace track.
     """
     from ..checker.resilient import default_probe_cmd, drive
+    from ..obs.trace import NULL_TRACER
     from ..utils import events as ev
 
     os.makedirs(spool_dir, exist_ok=True)
@@ -99,6 +103,8 @@ def supervised_device_check(
             max_restarts=max_restarts,
             probe_cmd=default_probe_cmd() if probe else None,
             log=log,
+            tracer=tracer if tracer is not None else NULL_TRACER,
+            trace_tid=job_id,
         )
         if not outcome.ok:
             return None

@@ -58,11 +58,25 @@ h1 { font-size: 17px; margin: 0 0 2px; }
        pointer-events: none; }
 .final { margin-top: 14px; }
 code { background: #f4f5f7; padding: 1px 4px; border-radius: 3px; }
+.frontier { margin-top: 18px; }
+.frontier h2 { font-size: 14px; margin: 0 0 6px; }
+.flayer { display: flex; align-items: center; margin: 1px 0; }
+.flayer-label { width: 84px; flex: none; text-align: right; padding-right: 10px;
+                color: #5f6672; font-size: 11px;
+                font-variant-numeric: tabular-nums; }
+.flayer-track { position: relative; flex: 1; height: 14px;
+                background: #f4f5f7; border-radius: 3px; }
+.fbar { position: absolute; top: 1px; left: 0; height: 12px; min-width: 2px;
+        border-radius: 2px; background: #7ea6e0; cursor: default;
+        border: 1px solid rgba(0,0,0,.2); }
+.fbar.spill { background: #c9a0dc; }
+.fbar.closed { background: #e0b97e; }
+.fnote { color: #5f6672; font-size: 11px; margin-top: 4px; }
 """
 
 _JS = """
 const tip = document.getElementById('tip');
-document.querySelectorAll('.op').forEach(el => {
+document.querySelectorAll('.op, .fbar').forEach(el => {
   el.addEventListener('mousemove', e => {
     tip.textContent = el.dataset.tip;
     tip.style.display = 'block';
@@ -139,6 +153,67 @@ def _is_valid_order(history: History, seq: list[int]) -> bool:
         if suffix_min_ret[i + 1] < max_call:
             return False
     return True
+
+
+def _frontier_panel(result: CheckResult) -> str:
+    """Frontier-timeline panel: one row per BFS layer, bar width scaled
+    (log) by frontier size against the widest layer, from the per-layer
+    ``FrontierStats.timeline`` that ``profile=`` collection attaches.
+    Returns "" when the result carries no timeline."""
+    import math
+
+    st = getattr(result, "stats", None)
+    timeline = getattr(st, "timeline", None) if st is not None else None
+    if not timeline:
+        return ""
+    peak = max(int(e.get("frontier") or 0) for e in timeline) or 1
+    rows = []
+    for e in timeline:
+        fr = int(e.get("frontier") or 0)
+        width = (
+            100.0 * math.log1p(fr) / math.log1p(peak) if peak > 1 else 100.0
+        )
+        classes = ["fbar"]
+        if e.get("spill"):
+            classes.append("spill")
+        elif e.get("auto_closed"):
+            classes.append("closed")
+        tip_parts = [
+            f"layer {e.get('layer')}",
+            f"frontier width: {fr}",
+            f"state-set size: {e.get('states')}",
+            f"auto-closed here: {e.get('auto_closed')}",
+            f"elapsed: {e.get('elapsed_s')}s",
+        ]
+        if "stop" in e:
+            seg = f"segment stop: {e['stop']}"
+            if "bucket" in e:
+                seg += f" (bucket {e['bucket']})"
+            tip_parts.append(seg)
+        if e.get("spill"):
+            tip_parts.append("out-of-core spill layer")
+        tip = html.escape("\n".join(tip_parts), quote=True).replace(
+            "\n", "&#10;"
+        )
+        rows.append(
+            f'<div class="flayer">'
+            f'<div class="flayer-label">L{e.get("layer")} · {fr}</div>'
+            f'<div class="flayer-track">'
+            f'<div class="{" ".join(classes)}" style="width:{width:.2f}%" '
+            f'data-tip="{tip}"></div></div></div>'
+        )
+    note = (
+        f"{st.layers} layers, max frontier {st.max_frontier}, "
+        f"max state set {st.max_state_set}, expanded {st.expanded}, "
+        f"auto-closed {st.auto_closed}, pruned {st.pruned}"
+    )
+    return (
+        '<div class="frontier"><h2>frontier timeline</h2>'
+        + "".join(rows)
+        + f'<div class="fnote">{html.escape(note)} &mdash; bar width is '
+        f"log-scaled frontier size; purple = out-of-core spill layer, "
+        f"amber = auto-closes fired</div></div>"
+    )
 
 
 def _op_class(op: Op) -> str:
@@ -373,6 +448,9 @@ def render_html(
                 for cl in all_clients
             )
             pieces.append(f'<div class="final">per client:{rows}</div>')
+    panel = _frontier_panel(result)
+    if panel:
+        pieces.append(panel)
     body = "\n".join(pieces)
     cfg_json = ""
     if cfgs:

@@ -1,0 +1,255 @@
+"""Observability smoke: boot verifyd with metrics + tracing, drive a
+short load, then assert the whole surface actually works.
+
+What it checks (the `make obs` gate):
+
+1. GET /metrics answers valid Prometheus text exposition — required
+   families present (``verifyd_jobs_completed_total``, the
+   ``verifyd_queue_wait_seconds`` histogram, per-backend
+   ``verifyd_wall_seconds`` histograms), every histogram's bucket counts
+   monotone non-decreasing with ``+Inf`` == ``_count``;
+2. the ``stats`` op snapshot carries the merged ``metrics`` section and
+   agrees with the scrape on jobs completed;
+3. the ``trace`` op returns Chrome trace_event JSON (Object Format) with
+   the nested admit→prepare and search→engine span structure, every
+   event JSON-serializable and ``ph``-valid — i.e. Perfetto-loadable;
+4. per-job ``profile`` payloads ride the submit replies when the daemon
+   runs with ``profile=True``.
+
+Exit 0 on success, 1 with a diagnostic on the first violated property.
+Pure stdlib + the package; runs on CPU in a few seconds.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REQUIRED_FAMILIES = (
+    "verifyd_jobs_submitted_total",
+    "verifyd_jobs_completed_total",
+    "verifyd_cache_hits_total",
+    "verifyd_active_jobs",
+    "verifyd_queue_wait_seconds",
+    "verifyd_wall_seconds",
+)
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _parse_families(body: str) -> dict[str, str]:
+    """# TYPE lines → {family: kind}; also sanity-checks line shapes."""
+    kinds: dict[str, str] = {}
+    for line in body.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split()
+            kinds[name] = kind
+    return kinds
+
+
+def _histogram_series(body: str, family: str) -> dict[str, dict]:
+    """Collect one histogram family's series from the exposition text:
+    {labelset-sans-le: {"buckets": [(le, n), ...], "count": n, "sum": x}}."""
+    out: dict[str, dict] = {}
+
+    def slot(labels: str) -> dict:
+        return out.setdefault(labels, {"buckets": [], "count": None, "sum": None})
+
+    for line in body.splitlines():
+        if line.startswith("#") or not line.startswith(family):
+            continue
+        name_labels, value = line.rsplit(" ", 1)
+        if name_labels.startswith(family + "_bucket{"):
+            labels = name_labels[len(family + "_bucket{") : -1]
+            parts = [p for p in labels.split(",") if p and not p.startswith("le=")]
+            le = next(
+                p.split("=", 1)[1].strip('"')
+                for p in labels.split(",")
+                if p.startswith("le=")
+            )
+            slot(",".join(parts))["buckets"].append((le, float(value)))
+        elif name_labels.startswith(family + "_count"):
+            labels = name_labels[len(family + "_count") :].strip("{}")
+            slot(labels)["count"] = float(value)
+        elif name_labels.startswith(family + "_sum"):
+            labels = name_labels[len(family + "_sum") :].strip("{}")
+            slot(labels)["sum"] = float(value)
+    return out
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from s2_verification_tpu.collector.collect import (
+        CollectConfig,
+        collect_history,
+    )
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+    from s2_verification_tpu.utils import events as ev
+
+    texts = []
+    for seed, (clients, ops) in enumerate([(2, 8), (3, 10), (2, 12)]):
+        hist = collect_history(
+            CollectConfig(
+                num_concurrent_clients=clients,
+                num_ops_per_client=ops,
+                seed=seed,
+            )
+        )
+        buf = io.StringIO()
+        ev.write_history(hist, buf)
+        texts.append(buf.getvalue())
+
+    with tempfile.TemporaryDirectory(prefix="obs-check-") as d:
+        sock = os.path.join(d, "verifyd.sock")
+        cfg = VerifydConfig(
+            socket_path=sock,
+            out_dir=os.path.join(d, "viz"),
+            no_viz=True,
+            stats_log=None,
+            device="off",
+            metrics_port=0,  # ephemeral
+            profile=True,
+        )
+        with Verifyd(cfg) as daemon:
+            client = VerifydClient(sock)
+            # Short loadgen: every history twice — the second pass answers
+            # from the verdict cache, so cache metrics move too.
+            replies = []
+            for _ in range(2):
+                for i, text in enumerate(texts):
+                    replies.append(
+                        client.submit(text, client=f"obs-check{i}")
+                    )
+            if not all(r.get("verdict") in (0, 1, 2) for r in replies):
+                return _fail(f"unexpected verdicts: {replies}")
+            if not any(r.get("cached") for r in replies):
+                return _fail("second submission pass never hit the cache")
+            profiled = [r for r in replies if isinstance(r.get("profile"), dict)]
+            if not profiled:
+                return _fail("profile=True daemon attached no job profiles")
+            if not any(
+                "timeline" in p["profile"] or "phases" in p["profile"]
+                for p in profiled
+            ):
+                return _fail(
+                    "job profiles carry neither a frontier timeline nor "
+                    "native phase attribution"
+                )
+
+            port = daemon.metrics_port
+            if not port:
+                return _fail("daemon exposed no metrics_port")
+            url = f"http://127.0.0.1:{port}/metrics"
+            resp = urllib.request.urlopen(url, timeout=5)
+            ctype = resp.headers.get("Content-Type", "")
+            body = resp.read().decode("utf-8")
+            if "version=0.0.4" not in ctype:
+                return _fail(f"wrong exposition Content-Type: {ctype!r}")
+
+            kinds = _parse_families(body)
+            for fam in REQUIRED_FAMILIES:
+                if fam not in kinds:
+                    return _fail(
+                        f"family {fam} missing from /metrics "
+                        f"(have: {sorted(kinds)})"
+                    )
+            if kinds["verifyd_queue_wait_seconds"] != "histogram":
+                return _fail("verifyd_queue_wait_seconds is not a histogram")
+            if kinds["verifyd_wall_seconds"] != "histogram":
+                return _fail("verifyd_wall_seconds is not a histogram")
+
+            # Histogram integrity: buckets monotone, +Inf == _count.
+            for fam in ("verifyd_queue_wait_seconds", "verifyd_wall_seconds"):
+                series = _histogram_series(body, fam)
+                if not series:
+                    return _fail(f"{fam}: no series in the exposition")
+                for labels, s in series.items():
+                    ns = [n for _, n in s["buckets"]]
+                    if ns != sorted(ns):
+                        return _fail(f"{fam}{{{labels}}}: non-monotone buckets {ns}")
+                    if not s["buckets"] or s["buckets"][-1][0] != "+Inf":
+                        return _fail(f"{fam}{{{labels}}}: missing +Inf bucket")
+                    if s["count"] is None or ns[-1] != s["count"]:
+                        return _fail(
+                            f"{fam}{{{labels}}}: +Inf {ns[-1]} != _count {s['count']}"
+                        )
+            wall_series = _histogram_series(body, "verifyd_wall_seconds")
+            if not any("backend=" in labels for labels in wall_series):
+                return _fail(
+                    f"verifyd_wall_seconds has no backend label: "
+                    f"{sorted(wall_series)}"
+                )
+
+            # Scrape vs stats-op agreement.
+            done = len(replies)
+            completed = sum(
+                float(line.rsplit(" ", 1)[1])
+                for line in body.splitlines()
+                if line.startswith("verifyd_jobs_completed_total")
+                or line.startswith("verifyd_cache_hits_total")
+            )
+            if completed != done:
+                return _fail(
+                    f"completed+cached in scrape = {completed}, "
+                    f"submitted {done}"
+                )
+            snap = client.stats()
+            if "metrics" not in snap:
+                return _fail("stats op snapshot lacks the metrics section")
+            if snap.get("metrics_port") != port:
+                return _fail("stats op does not advertise the metrics port")
+
+            # Trace export: valid trace_event JSON, nested spans.
+            trace = client.trace()
+            events = trace.get("traceEvents")
+            if not isinstance(events, list) or not events:
+                return _fail("trace op returned no traceEvents")
+            json.dumps(trace)  # must round-trip
+            for e in events:
+                if e.get("ph") not in ("X", "M"):
+                    return _fail(f"unexpected trace phase: {e}")
+                if e["ph"] == "X" and not all(
+                    k in e for k in ("name", "ts", "dur", "pid", "tid")
+                ):
+                    return _fail(f"incomplete X event: {e}")
+            spans = [e for e in events if e["ph"] == "X"]
+            admits = [e for e in spans if e["name"] == "admit"]
+            searches = [e for e in spans if e["name"] == "search"]
+            if not admits or not searches:
+                return _fail(
+                    f"missing admit/search spans: "
+                    f"{sorted({e['name'] for e in spans})}"
+                )
+            # Nesting: each non-cached admit contains a prepare on its track.
+            ok_nest = False
+            for a in admits:
+                for p in spans:
+                    if (
+                        p["name"] == "prepare"
+                        and p["tid"] == a["tid"]
+                        and a["ts"] <= p["ts"]
+                        and p["ts"] + p["dur"] <= a["ts"] + a["dur"] + 1e-3
+                    ):
+                        ok_nest = True
+            if not ok_nest:
+                return _fail("no admit span contains a prepare span")
+
+    print(
+        f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
+        f"{len(spans)} spans, {len(profiled)} profiled jobs"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

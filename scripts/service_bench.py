@@ -102,6 +102,13 @@ def main() -> int:
     ap.add_argument("--no-viz", action="store_true", default=True)
     ap.add_argument("--viz", dest="no_viz", action="store_false")
     ap.add_argument("--seed-collect", action="store_true")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="in-process daemon only: serve Prometheus metrics "
+                    "on this port (0 = ephemeral) and print a scrape "
+                    "summary after the run")
+    ap.add_argument("--trace-out", default=None, metavar="OUT.json",
+                    help="in-process daemon only: export the daemon's span "
+                    "ring as Chrome trace_event JSON after the run")
     args = ap.parse_args()
 
     paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
@@ -122,6 +129,13 @@ def main() -> int:
     daemon_ctx = None
     if args.socket:
         sock = args.socket
+        if args.metrics_port is not None or args.trace_out:
+            print(
+                "# --metrics-port/--trace-out only apply to the in-process "
+                "daemon; ignoring (use the serve flags / `trace` subcommand "
+                "against a live daemon)",
+                file=sys.stderr,
+            )
     else:
         from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
 
@@ -137,9 +151,15 @@ def main() -> int:
                 no_viz=args.no_viz,
                 out_dir=os.path.join(tmp, "viz"),
                 stats_log=None,
+                metrics_port=args.metrics_port,
             )
         )
         daemon_ctx.__enter__()
+        if daemon_ctx.metrics_port is not None:
+            print(
+                f"# metrics: http://127.0.0.1:{daemon_ctx.metrics_port}/metrics",
+                file=sys.stderr,
+            )
 
     # Work list: every history x repeat, interleaved so duplicates arrive
     # spread out (cache hits mid-stream, like real resubmission traffic).
@@ -231,6 +251,28 @@ def main() -> int:
             ),
             flush=True,
         )
+        if daemon_ctx is not None:
+            if daemon_ctx.metrics_port is not None:
+                import urllib.request
+
+                url = f"http://127.0.0.1:{daemon_ctx.metrics_port}/metrics"
+                body = urllib.request.urlopen(url, timeout=5).read().decode()
+                families = sorted(
+                    {
+                        line.split()[2]
+                        for line in body.splitlines()
+                        if line.startswith("# TYPE ")
+                    }
+                )
+                print(
+                    f"# scraped {len(body)} bytes, "
+                    f"{len(families)} metric families: {', '.join(families)}",
+                    file=sys.stderr,
+                )
+            if args.trace_out:
+                with open(args.trace_out, "w", encoding="utf-8") as f:
+                    json.dump(daemon_ctx.tracer.export(), f)
+                print(f"# trace written to {args.trace_out}", file=sys.stderr)
         return 0
     finally:
         if daemon_ctx is not None:

@@ -479,3 +479,82 @@ def test_check_device_rows_flag(history_path, tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_check_profile_writes_search_timeline(history_path, tmp_path):
+    out = tmp_path / "profile.json"
+    rc = main(
+        [
+            "check",
+            "-file",
+            history_path,
+            "-backend",
+            "frontier",
+            "-no-viz",
+            "-profile",
+            str(out),
+        ]
+    )
+    assert rc == 0
+    prof = json.loads(out.read_text(encoding="utf-8"))
+    assert prof["outcome"] == "ok"
+    assert prof["backend"] == "frontier"
+    assert prof["layers"] == len(prof["timeline"])
+    for entry in prof["timeline"]:
+        assert {"layer", "frontier", "states", "auto_closed", "elapsed_s"} <= set(
+            entry
+        )
+
+
+def test_check_profile_ignored_in_corpus_mode(history_path, tmp_path):
+    # Corpus mode cannot multiplex one profile file; it must warn+ignore
+    # rather than clobber or crash.
+    out = tmp_path / "profile.json"
+    corpus_dir = os.path.dirname(history_path)
+    rc = main(
+        [
+            "check",
+            "-file",
+            corpus_dir,
+            "-backend",
+            "frontier",
+            "-no-viz",
+            "-profile",
+            str(out),
+        ]
+    )
+    assert rc == 0
+    assert not out.exists()
+
+
+def test_trace_subcommand_unavailable_exit69(tmp_path):
+    from s2_verification_tpu.service.protocol import EXIT_UNAVAILABLE
+
+    rc = main(
+        ["trace", "-socket", str(tmp_path / "nope.sock"), "-out", "-"]
+    )
+    assert rc == EXIT_UNAVAILABLE
+
+
+def test_trace_subcommand_exports_daemon_spans(history_path, tmp_path):
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+
+    sock = str(tmp_path / "v.sock")
+    cfg = VerifydConfig(
+        socket_path=sock,
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(sock)
+        with open(history_path, encoding="utf-8") as f:
+            client.submit(f.read(), client="cli-test")
+        out = tmp_path / "trace.json"
+        rc = main(["trace", "-socket", sock, "-out", str(out)])
+        assert rc == 0
+        trace = json.loads(out.read_text(encoding="utf-8"))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert {"admit", "search"} <= names

@@ -166,3 +166,86 @@ def test_frontier_witness_opt_out_and_deepest():
             assert all(0 <= j < len(hist.ops) for j in res.deepest)
             saw_illegal = True
     assert saw_ok and saw_illegal
+
+
+def test_frontier_stats_fields_on_known_history():
+    # Satellite regression: pin every FrontierStats field on a history
+    # whose search shape is knowable by hand.  A single client appending
+    # sequentially has exactly one state and one frontier node per layer:
+    # layers == ops, max_frontier == 1, nothing auto-closed or pruned.
+    h = H()
+    acc, tail = 0, 0
+    for rh in (11, 22, 33, 44):
+        h.append_ok(1, [rh], tail=tail + 1)
+        acc = fold([rh], start=acc)
+        tail += 1
+    h.read_ok(1, tail=tail, stream_hash=acc)
+    hist = prepare(h.events)
+    res = check_frontier(hist, collect_stats=True)
+    assert res.outcome == CheckOutcome.OK
+    st = res.stats
+    # One layer per linearized op plus the final layer that observes the
+    # accept (no expansion happens there: expanded stays == ops).
+    assert st.layers == len(hist.ops) + 1
+    assert st.max_frontier == 1
+    assert st.max_state_set == 1
+    assert st.auto_closed == 0
+    assert st.pruned == 0
+    assert st.expanded == len(hist.ops)
+    # collect_stats alone gathers no per-layer timeline (profile= does).
+    assert st.timeline == []
+
+
+def test_frontier_stats_counts_auto_closed_dead_guard():
+    # One open append with a guard already dead at the open: the frontier
+    # auto-closes it instead of branching, and the accountant sees it.
+    h = H()
+    h.append_ok(1, [5], tail=1)  # bumps the match seq past 0
+    h.call_append(2, [7], match=0)  # guard 0 is dead: must fail, stays open
+    h.read_ok(1, tail=1, stream_hash=fold([5]))
+    hist = prepare(h.events)
+    res = check_frontier(hist, collect_stats=True)
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats.auto_closed >= 1
+
+
+def test_frontier_profile_timeline_shape():
+    # profile=True implies stats collection and fills one entry per layer
+    # with the documented keys, cumulative elapsed, and a frontier column
+    # that matches the recorded maximum.
+    h = H()
+    acc, tail = 0, 0
+    for i in range(3):
+        h.append_ok(1 + (i % 2), [100 + i], tail=tail + 1)
+        acc = fold([100 + i], start=acc)
+        tail += 1
+    h.read_ok(1, tail=tail, stream_hash=acc)
+    hist = prepare(h.events)
+    res = check_frontier(hist, profile=True)
+    assert res.outcome == CheckOutcome.OK
+    st = res.stats
+    assert st is not None  # profile implies collect_stats
+    tl = st.timeline
+    assert len(tl) == st.layers
+    assert [e["layer"] for e in tl] == list(range(1, st.layers + 1))
+    for e in tl:
+        assert set(e) >= {"layer", "frontier", "states", "auto_closed", "elapsed_s"}
+        assert e["frontier"] >= 1
+        assert e["states"] >= 1
+        assert e["elapsed_s"] >= 0.0
+    assert max(e["frontier"] for e in tl) == st.max_frontier
+    assert max(e["states"] for e in tl) == st.max_state_set
+    assert sum(e["auto_closed"] for e in tl) == st.auto_closed
+    # elapsed is cumulative since search start: non-decreasing.
+    elapsed = [e["elapsed_s"] for e in tl]
+    assert elapsed == sorted(elapsed)
+
+
+def test_frontier_auto_passes_profile_through():
+    h = H()
+    h.append_ok(1, [9], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([9]))
+    hist = prepare(h.events)
+    res = check_frontier_auto(hist, profile=True)
+    assert res.outcome == CheckOutcome.OK
+    assert res.stats is not None and len(res.stats.timeline) == res.stats.layers

@@ -1,0 +1,317 @@
+"""Observability layer tests: tracer ring, metrics registry + exposition,
+the metrics HTTP endpoint, ServiceStats sink resilience + metric hooks,
+and the daemon's trace/metrics/profile surface end to end.
+
+Runs under the session-wide ``JAX_PLATFORMS=cpu`` pin (conftest.py) with
+device escalation off.
+"""
+
+import io
+import json
+import urllib.request
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.obs import MetricsRegistry, Tracer
+from s2_verification_tpu.obs.httpd import MetricsServer
+from s2_verification_tpu.obs.metrics import LATENCY_BUCKETS
+from s2_verification_tpu.obs.trace import NULL_TRACER
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.stats import ServiceStats
+from s2_verification_tpu.utils import events as ev
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_tracer_spans_nest_and_export_is_trace_event_json():
+    t = Tracer()
+    with t.span("outer", tid=7, args={"k": "v"}):
+        with t.span("inner", tid=7):
+            pass
+    out = t.export()
+    # Valid Object Format: traceEvents list, JSON-serializable.
+    json.loads(json.dumps(out))
+    evs = {e["name"]: e for e in out["traceEvents"]}
+    outer, inner = evs["outer"], evs["inner"]
+    for e in (outer, inner):
+        assert e["ph"] == "X"
+        assert e["tid"] == 7
+        assert e["dur"] >= 0
+    # Temporal containment (the property Perfetto renders as nesting).
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert outer["args"] == {"k": "v"}
+
+
+def test_tracer_ring_is_bounded_and_counts_drops():
+    t = Tracer(capacity=4)
+    for i in range(10):
+        t0 = t.now()
+        t.add_span(f"s{i}", t0, t.now())
+    out = t.export()
+    assert len(out["traceEvents"]) == 4
+    # Oldest evicted, newest kept.
+    assert [e["name"] for e in out["traceEvents"]] == ["s6", "s7", "s8", "s9"]
+    assert out["otherData"]["spans_dropped"] == 6
+
+
+def test_tracer_track_names_emit_metadata_once():
+    t = Tracer()
+    t.name_track(3, "job 3")
+    t.name_track(3, "job 3")  # dedup
+    meta = [e for e in t.export()["traceEvents"] if e["ph"] == "M"]
+    assert len(meta) == 1
+    assert meta[0]["name"] == "thread_name"
+    assert meta[0]["args"]["name"] == "job 3"
+
+
+def test_null_tracer_is_disabled_and_free():
+    assert not NULL_TRACER.enabled
+    with NULL_TRACER.span("x"):
+        pass
+    assert len(NULL_TRACER) == 0
+
+
+# -- metrics registry --------------------------------------------------------
+
+
+def test_counter_and_gauge_render_prometheus_text():
+    r = MetricsRegistry()
+    c = r.counter("jobs_total", "All jobs", labelnames=("verdict",))
+    c.inc(verdict="ok")
+    c.inc(2, verdict="illegal")
+    g = r.gauge("active", "Active jobs")
+    g.set(3)
+    g.dec()
+    text = r.render()
+    assert "# TYPE jobs_total counter" in text
+    assert 'jobs_total{verdict="ok"} 1' in text
+    assert 'jobs_total{verdict="illegal"} 2' in text
+    assert "# TYPE active gauge" in text
+    assert "active 2" in text
+    assert text.endswith("\n")
+    with pytest.raises(ValueError):
+        c.inc(-1, verdict="ok")
+    with pytest.raises(ValueError):
+        r.gauge("jobs_total", "kind clash")
+
+
+def test_histogram_bucket_boundaries_are_inclusive_le():
+    # Satellite check: an observation exactly ON a boundary lands in that
+    # bucket (Prometheus `le` semantics), not the next one.
+    r = MetricsRegistry()
+    h = r.histogram("lat", "Latency", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.1)  # == first boundary → le="0.1"
+    h.observe(1.0)  # == second boundary → le="1.0"
+    h.observe(10.0000001)  # just past the last finite boundary → +Inf only
+    cum, total, count = h.counts()
+    assert cum == [1, 2, 2, 3]  # cumulative per le, +Inf last
+    assert count == 3
+    assert total == pytest.approx(11.1000001)
+    text = r.render()
+    # Integer-valued bounds render bare ("1", not "1.0") — the Go client's
+    # %g convention.
+    assert 'lat_bucket{le="0.1"} 1' in text
+    assert 'lat_bucket{le="1"} 2' in text
+    assert 'lat_bucket{le="10"} 2' in text
+    assert 'lat_bucket{le="+Inf"} 3' in text
+    assert "lat_count 3" in text
+
+
+def test_histogram_labels_and_latency_defaults():
+    r = MetricsRegistry()
+    h = r.histogram(
+        "wall", "Wall", buckets=LATENCY_BUCKETS, labelnames=("backend",)
+    )
+    h.observe(0.002, backend="native")
+    h.observe(50.0, backend="device")
+    text = r.render()
+    assert 'wall_bucket{backend="native",le="0.0025"} 1' in text
+    assert 'wall_bucket{backend="device",le="+Inf"} 1' in text
+    snap = r.snapshot()
+    assert snap["histograms"]['wall{backend="native"}']["count"] == 1
+
+
+def test_label_values_are_escaped():
+    r = MetricsRegistry()
+    c = r.counter("x_total", "X", labelnames=("path",))
+    c.inc(path='a"b\\c\nd')
+    assert 'path="a\\"b\\\\c\\nd"' in r.render()
+
+
+# -- metrics HTTP endpoint ---------------------------------------------------
+
+
+def test_metrics_server_serves_exposition_and_404():
+    r = MetricsRegistry()
+    r.counter("hits_total", "Hits").inc()
+    srv = MetricsServer(r, port=0)
+    try:
+        resp = urllib.request.urlopen(srv.url, timeout=5)  # …/metrics
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        assert "hits_total 1" in resp.read().decode()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/nope", timeout=5
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+# -- ServiceStats: sink resilience + metric hooks ----------------------------
+
+
+class _FlakySink(io.StringIO):
+    """Raises OSError on the first N write attempts, then behaves."""
+
+    def __init__(self, failures: int):
+        super().__init__()
+        self.failures = failures
+        self.attempts = 0
+
+    def write(self, s: str) -> int:
+        self.attempts += 1
+        if self.attempts <= self.failures:
+            raise OSError("transient")
+        return super().write(s)
+
+
+def test_stats_sink_survives_one_transient_oserror():
+    sink = _FlakySink(failures=1)
+    s = ServiceStats(sink)
+    s.emit("admit", job=1)
+    # Retried once, succeeded: event on the sink, sink kept, no loss count.
+    assert '"ev":"admit"' in sink.getvalue()
+    assert s.snapshot()["stats_sink_lost"] == 0
+    s.emit("admit", job=2)
+    assert sink.getvalue().count('"ev":"admit"') == 2
+
+
+def test_stats_sink_dropped_after_two_failures_with_counter():
+    sink = _FlakySink(failures=100)
+    s = ServiceStats(sink)
+    s.emit("admit", job=1)
+    snap = s.snapshot()
+    assert snap["stats_sink_lost"] == 1
+    assert sink.attempts == 2  # exactly one retry
+    # Counters keep working without the sink; no more write attempts.
+    s.emit("admit", job=2)
+    assert sink.attempts == 2
+    assert s.snapshot()["admitted"] == 2
+    assert (
+        'verifyd_stats_sink_lost_total 1' in s.registry.render()
+    )
+
+
+def test_stats_closed_sink_drops_without_retry():
+    sink = io.StringIO()
+    sink.close()
+    s = ServiceStats(sink)
+    s.emit("admit", job=1)  # ValueError path: no retry, accounted drop
+    assert s.snapshot()["stats_sink_lost"] == 1
+
+
+def test_cache_loaded_counter_accumulates():
+    s = ServiceStats(None)
+    s.emit("cache_loaded", entries=3)
+    s.emit("cache_loaded", entries=2)
+    assert s.snapshot()["cache_loaded"] == 5
+    assert "verifyd_cache_loaded_total 5" in s.registry.render()
+
+
+def test_stats_events_drive_metrics_registry():
+    s = ServiceStats(None)
+    s.emit("admit", job=1)
+    s.emit("start", job=1, queue_wait_s=0.004)
+    s.emit("done", job=1, wall_s=0.5, verdict=0, backend="native")
+    s.emit("admit", job=2)
+    s.emit("start", job=2)
+    s.emit("job_error", job=2, reason="boom")
+    text = s.registry.render()
+    assert "verifyd_jobs_submitted_total 2" in text
+    assert 'verifyd_jobs_completed_total{verdict="ok"} 1' in text
+    assert 'verifyd_wall_seconds_bucket{backend="native",le="0.5"} 1' in text
+    assert "verifyd_job_errors_total 1" in text
+    assert "verifyd_active_jobs 0" in text  # start/done and start/job_error balance
+    snap = s.snapshot()
+    assert snap["active"] == 0
+    assert snap["metrics"]["counters"]["verifyd_jobs_submitted_total"] == 2
+
+
+# -- daemon surface ----------------------------------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _good() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    return _text(h)
+
+
+def test_daemon_metrics_trace_and_profile_surface(tmp_path):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        metrics_port=0,
+        profile=True,
+    )
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path)
+        rep = client.submit(_good(), client="obs-test")
+        assert rep["verdict"] == 0
+        # Per-job profile rides the reply and names the search shape.
+        prof = rep["profile"]
+        assert prof["steps"] >= 0
+        assert "timeline" in prof or "phases" in prof
+
+        # stats op: merged metrics section + advertised port.
+        snap = client.stats()
+        assert snap["metrics_port"] == daemon.metrics_port
+        assert snap["metrics"]["counters"]["verifyd_jobs_submitted_total"] == 1
+
+        # /metrics scrape agrees with the stats op.
+        url = f"http://127.0.0.1:{daemon.metrics_port}/metrics"
+        body = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert 'verifyd_jobs_completed_total{verdict="ok"} 1' in body
+        assert "verifyd_queue_wait_seconds_bucket" in body
+        assert 'verifyd_wall_seconds_bucket{backend="' in body
+
+        # trace op: nested admit→prepare on the job track; search present.
+        trace = client.trace()
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        names = {e["name"] for e in spans}
+        assert {"admit", "prepare", "queue_wait", "search"} <= names
+        admit = next(e for e in spans if e["name"] == "admit")
+        prep = next(e for e in spans if e["name"] == "prepare")
+        assert admit["tid"] == prep["tid"]
+        assert admit["ts"] <= prep["ts"]
+        assert prep["ts"] + prep["dur"] <= admit["ts"] + admit["dur"] + 1e-3
+        json.dumps(trace)  # Perfetto-loadable = valid JSON end to end
+
+
+def test_daemon_trace_disabled_with_zero_capacity(tmp_path):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        trace_capacity=0,
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path)
+        client.submit(_good(), client="obs-test")
+        trace = client.trace()
+        assert trace["traceEvents"] == []

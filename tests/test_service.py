@@ -390,6 +390,29 @@ def test_stats_retry_hint_is_clamped():
     assert s.retry_after_hint(100) == 30.0  # depth x avg, ceiling
 
 
+def test_stats_retry_hint_counts_in_flight_jobs():
+    # A deep queue behind busy workers drains no faster than the workers
+    # finish: jobs already handed to a worker (start without done) must
+    # inflate the hint alongside queued depth.
+    s = ServiceStats(None)
+    s.emit("done", wall_s=2.0, verdict=0)  # avg wall = 2s
+    assert s.retry_after_hint(1) == 2.0  # 1 queued, 0 in flight
+    s.emit("start", job=1)
+    s.emit("start", job=2)
+    assert s.retry_after_hint(1) == 6.0  # (1 queued + 2 in flight) x 2s
+    s.emit("done", job=1, wall_s=2.0, verdict=0)
+    assert s.retry_after_hint(1) == 4.0  # one landed: (1 + 1) x avg
+
+
+def test_stats_cache_loaded_is_additive_across_events():
+    s = ServiceStats(None)
+    s.emit("cache_loaded", entries=4)
+    s.emit("cache_loaded", entries=3)
+    # Regression: this used to be an assignment, so a second replay
+    # (multi-segment boot) silently overwrote the first.
+    assert s.snapshot()["cache_loaded"] == 7
+
+
 # -- supervised-device degradation -------------------------------------------
 
 
