@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: obs
+chaos-full: obs mesh
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -46,6 +46,13 @@ chaos-full: obs
 # integrity), the stats-op merge, and the Perfetto-loadable trace.
 obs:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_check.py
+
+# Multi-chip serving gate (scripts/mesh_check.py): 8 virtual CPU devices,
+# verifyd --mesh-devices 8 vs 1, same adversarial history through the
+# supervised sharded escalation path — verdicts must agree and the
+# per-shard metric families must populate.
+mesh:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/mesh_check.py
 
 clean:
 	$(MAKE) -C native clean

@@ -119,6 +119,27 @@ def _filter_xla_noise(text: str) -> str:
     return "".join(kept)
 
 
+def _run_filtered(cmd: list, env: dict) -> int:
+    """subprocess.run with the child's stderr routed through a temp file
+    and forwarded with :func:`_filter_xla_noise` applied.  The mesh
+    children re-load one jitted executable per virtual device, so their
+    tails are ~95% repeated cpu_aot_loader machine-feature walls — without
+    the filter the MULTICHIP_r*.json stderr tail buries the metric line.
+    Same no-pipes discipline as _isolated_device_run (a wedged grandchild
+    would hold a pipe open forever)."""
+    import subprocess
+    import tempfile
+
+    with tempfile.TemporaryFile() as errf:
+        rc = subprocess.run(cmd, env=env, stderr=errf).returncode
+        errf.seek(0)
+        errtxt = _filter_xla_noise(errf.read().decode(errors="replace"))
+        if errtxt:
+            sys.stderr.write(errtxt)
+            sys.stderr.flush()
+    return rc
+
+
 def _zero_line(note: str) -> int:
     print(f"# {note}", file=sys.stderr)
     print(
@@ -758,15 +779,15 @@ def _reexec_mesh(n: int) -> int:
     if on_hardware:
         print(f"# mesh: {n} hardware devices detected", file=sys.stderr)
         env.pop("JAX_PLATFORMS", None)
-        return subprocess.run(
+        return _run_filtered(
             [
                 sys.executable,
                 "-c",
                 f"import sys\nsys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})\n"
                 f"import bench\nraise SystemExit(bench.mesh_scaling({n}))\n",
             ],
-            env=env,
-        ).returncode
+            env,
+        )
 
     print(
         f"# mesh: no {n}-device hardware; virtual CPU mesh "
@@ -789,10 +810,10 @@ def _reexec_mesh(n: int) -> int:
         ]
     env["XLA_FLAGS"] = " ".join(flags)
     env["JAX_PLATFORMS"] = "cpu"
-    return subprocess.run(
+    return _run_filtered(
         [sys.executable, "-c", _cpu_child_code(f"bench.mesh_scaling({n})")],
-        env=env,
-    ).returncode
+        env,
+    )
 
 
 def main() -> int:

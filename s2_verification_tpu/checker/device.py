@@ -307,6 +307,55 @@ def place_frontier(frontier: Frontier, mesh, axis: str = "fr") -> Frontier:
     return jax.tree.map(put, frontier)
 
 
+def _shard_occupancy(frontier: Frontier, mesh):
+    """Per-device live-row counts of a mesh-placed frontier.
+
+    The frontier axis is sharded evenly over the mesh's 1-D device axis
+    (``place_frontier``), so reshaping ``valid`` to ``(n_shards, -1)`` and
+    reducing axis 1 is a shard-local sum — XLA keeps each partial on its
+    device and only the [n_shards] result crosses the interconnect.  The
+    fetch is also the segment's cross-shard sync barrier: its wall time is
+    the collective/straggler cost the per-shard metrics report.
+    """
+    n = int(mesh.devices.size)
+    t0 = time.monotonic()
+    per = device_get(frontier.valid.reshape(n, -1).sum(axis=1))
+    return np.asarray(per, dtype=np.int64), time.monotonic() - t0
+
+
+def _note_shard_stats(stats, mesh, live_per_shard, sync_s: float) -> None:
+    """Fold one segment's per-shard occupancy into ``stats.shards``.
+
+    A checkpoint resumed onto a *different* chip set (verifyd re-grant)
+    carries the old grant's shard summary; the summary describes the
+    current mesh, so a device-set mismatch starts it fresh.
+    """
+    devs = [str(d) for d in mesh.devices.flat]
+    if len(stats.shards) != len(devs) or any(
+        e.get("device") != d for e, d in zip(stats.shards, devs)
+    ):
+        stats.shards = [
+            {
+                "shard": i,
+                "device": d,
+                "peak_occupancy": 0,
+                "occupancy_sum": 0,
+                "segments": 0,
+                "collective_wall_s": 0.0,
+                "skew": 1.0,
+            }
+            for i, d in enumerate(devs)
+        ]
+    for e, n in zip(stats.shards, live_per_shard):
+        e["peak_occupancy"] = max(e["peak_occupancy"], int(n))
+        e["occupancy_sum"] += int(n)
+        e["segments"] += 1
+        e["collective_wall_s"] = round(e["collective_wall_s"] + sync_s, 6)
+    mean_peak = sum(e["peak_occupancy"] for e in stats.shards) / len(devs)
+    for e in stats.shards:
+        e["skew"] = round(e["peak_occupancy"] / mean_peak, 4) if mean_peak else 1.0
+
+
 # ---------------------------------------------------------------------------
 # Per-row pieces (to be vmapped over the frontier axis)
 # ---------------------------------------------------------------------------
@@ -1604,6 +1653,10 @@ def check_device(
     f = _round_pow2(
         max(min(start_frontier, f_cap), len(enc.init_states)), 2
     )
+    if mesh is not None:
+        # Even sharding needs the frontier axis divisible by the shard
+        # count; the smallest bucket under a mesh is one row per device.
+        f = max(f, _round_pow2(int(mesh.devices.size), 2))
     frontier = None
 
     if checkpoint_path is not None:
@@ -1693,6 +1746,12 @@ def check_device(
                 tok=jnp.asarray(ck.tok),
                 valid=jnp.asarray(ck.valid),
             )
+            if mesh is not None and f < int(mesh.devices.size):
+                # Resumed onto a wider mesh than the snapshot's bucket
+                # (re-grant): grow to one row per device so the placement
+                # below shards evenly.
+                f = _round_pow2(int(mesh.devices.size), 2)
+                frontier = _regrow_device(frontier, capacity=f)
 
         def _snapshot(fr: Frontier) -> None:
             save_checkpoint(
@@ -1811,18 +1870,24 @@ def check_device(
         # candidate-set-width statistic is meaningful only for host engines.
         stats.auto_closed += int(seg_auto_closed)
         stats.expanded += int(seg_expanded)
+        seg_shards = None
+        if mesh is not None and collect_stats:
+            seg_shards, sync_s = _shard_occupancy(out.frontier, mesh)
+            _note_shard_stats(stats, mesh, seg_shards, sync_s)
         if profile:
-            stats.timeline.append(
-                {
-                    "layer": stats.layers,
-                    "frontier": int(seg_max_live),
-                    "states": int(live),
-                    "auto_closed": int(seg_auto_closed),
-                    "elapsed_s": round(time.monotonic() - t_run0, 6),
-                    "stop": ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
-                    "bucket": f,
-                }
-            )
+            entry = {
+                "layer": stats.layers,
+                "frontier": int(seg_max_live),
+                "states": int(live),
+                "auto_closed": int(seg_auto_closed),
+                "elapsed_s": round(time.monotonic() - t_run0, 6),
+                "stop": ("RUNNING", "ACCEPT", "EMPTY", "CAPACITY")[code],
+                "bucket": f,
+            }
+            if seg_shards is not None:
+                entry["shards"] = [int(x) for x in seg_shards]
+                entry["sync_s"] = round(sync_s, 6)
+            stats.timeline.append(entry)
         deep_counts = deep_np
         if allow_prune:
             stats.pruned = stats.pruned or bool(seg_pruned)

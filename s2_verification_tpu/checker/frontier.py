@@ -69,6 +69,13 @@ class FrontierStats:
     #: compiled segment.  Plain dicts so ``dataclasses.asdict`` keeps the
     #: whole object JSON/checkpoint-serializable.
     timeline: list = field(default_factory=list)
+    #: per-shard summary (mesh-sharded device runs with stats collection
+    #: only): one dict per mesh device — ``{"shard", "device",
+    #: "peak_occupancy", "occupancy_sum", "segments", "collective_wall_s",
+    #: "skew"}`` — the raw material for verifyd's per-shard metric
+    #: families and the viz shard panel.  Plain dicts, same
+    #: serializability contract as ``timeline``.
+    shards: list = field(default_factory=list)
 
 
 def _op_dead_forever(

@@ -442,6 +442,57 @@ def _read_secret(args: argparse.Namespace) -> bytes | None:
     return env.encode("utf-8") if env else None
 
 
+def _resolve_mesh_devices(spec: str | None) -> int | None:
+    """Resolve ``serve --mesh-devices N|auto`` to a device-pool size.
+
+    ``auto`` counts visible devices: in-process when pinned to CPU (no
+    tunnel to hang on), else via a bounded probe child — the daemon
+    process itself must never initialize jax (a dead TPU tunnel *hangs*
+    backend init; see service/supervise.py).
+    """
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if s in ("", "0", "none", "off"):
+        return None
+    if s != "auto":
+        n = int(s)
+        if n < 1:
+            raise SystemExit(f"--mesh-devices must be >= 1, got {n}")
+        return n
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        from .utils.platform import pin_platform
+
+        pin_platform()
+        import jax
+
+        return len(jax.devices())
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "print(len(jax.devices()))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [_sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        if proc.returncode == 0:
+            return max(1, int(proc.stdout.strip().splitlines()[-1]))
+    except (subprocess.TimeoutExpired, OSError, ValueError, IndexError):
+        pass
+    log.warning(
+        "--mesh-devices auto: device probe failed; serving without a pool"
+    )
+    return None
+
+
 def _cmd_serve(args: argparse.Namespace) -> int:
     from .service.daemon import Verifyd, VerifydConfig
 
@@ -460,6 +511,17 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             "--tcp requires a shared secret (--secret-file or VERIFYD_SECRET)"
         )
         return USAGE_EXIT
+    mesh_devices = _resolve_mesh_devices(args.mesh_devices)
+    if (
+        mesh_devices is not None
+        and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    ):
+        # CPU rehearsal: provision the virtual devices *now*, before any
+        # jax init, so inline escalations and spawned children both see
+        # the requested topology (XLA_FLAGS is inherited through env).
+        from .utils.platform import ensure_host_device_count
+
+        ensure_host_device_count(mesh_devices)
     cfg = VerifydConfig(
         socket_path=args.socket,
         queue_depth=args.queue_depth,
@@ -478,6 +540,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         metrics_port=args.metrics_port,
         trace_capacity=args.trace_capacity,
         profile=args.profile,
+        mesh_devices=mesh_devices,
     )
     daemon = Verifyd(cfg)
 
@@ -843,6 +906,18 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="attach a per-job search-shape profile (FrontierStats + "
         "per-layer timeline) to every done event and submit reply",
+    )
+    s.add_argument(
+        "-mesh-devices",
+        "--mesh-devices",
+        default=None,
+        metavar="N|auto",
+        help="device-pool size for mesh-sharded escalations: escalating "
+        "jobs lease a power-of-two chip set sized by job shape and run "
+        "the frontier search sharded over exactly those chips, reported "
+        "as backend device-mesh[N] ('auto' = every visible device; "
+        "default: off — single-chip escalation). Under JAX_PLATFORMS=cpu "
+        "a numeric N provisions N virtual devices via XLA_FLAGS.",
     )
     s.set_defaults(fn=_cmd_serve, stats=False)
 

@@ -70,10 +70,24 @@ def init_distributed(
     )
 
 
-def frontier_mesh(axis: str = "fr"):
-    """A 1-D mesh over every (global) device, named for the frontier axis."""
-    import jax
+def frontier_mesh(axis: str = "fr", devices=None):
+    """A 1-D mesh named for the frontier axis.
+
+    ``devices`` is an explicit device list (e.g. a
+    :class:`~..service.devicepool.DevicePool` grant resolved through
+    ``jax.devices()``); the default spans every (global) device — but note
+    that default bakes in the assumption that one search owns the whole
+    slice, which stops holding once verifyd leases chip subsets to
+    concurrent jobs.
+    """
     import numpy as np
     from jax.sharding import Mesh
 
-    return Mesh(np.asarray(jax.devices()), (axis,))
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    devices = list(devices)
+    if not devices:
+        raise ValueError("frontier_mesh needs at least one device")
+    return Mesh(np.asarray(devices), (axis,))

@@ -112,6 +112,12 @@ class VerifydConfig:
     #: attach per-job search profiles (FrontierStats timeline, native
     #: phase attribution) to `done` events and submit replies
     profile: bool = False
+    #: size of the device pool for mesh-sharded escalations; None = the
+    #: single-chip path (no pool).  The daemon only tracks abstract slot
+    #: indices — device objects are resolved by escalation children
+    mesh_devices: int | None = None
+    #: how long an escalation waits for a lease before running unsharded
+    lease_timeout_s: float = 120.0
     extra: dict = field(default_factory=dict)
 
 
@@ -159,6 +165,13 @@ class Verifyd:
         self.queue = AdmissionQueue(
             config.queue_depth, retry_hint=self.stats.retry_after_hint
         )
+        self.device_pool = None
+        if config.mesh_devices and config.device != "off":
+            from .devicepool import DevicePool
+
+            self.device_pool = DevicePool(
+                config.mesh_devices, stats=self.stats
+            )
         self.scheduler = Scheduler(
             self.queue,
             self.cache,
@@ -175,6 +188,8 @@ class Verifyd:
             journal=self.journal,
             tracer=self.tracer,
             profile=config.profile,
+            device_pool=self.device_pool,
+            lease_timeout_s=config.lease_timeout_s,
         )
         self._job_ids = itertools.count(1)
         self._thread: threading.Thread | None = None
@@ -206,6 +221,7 @@ class Verifyd:
             workers=self.cfg.workers,
             queue_depth=self.cfg.queue_depth,
             pid=os.getpid(),
+            mesh_devices=self.cfg.mesh_devices,
         )
         self._thread = threading.Thread(
             target=self._run, name="verifyd-accept", daemon=True
@@ -468,6 +484,8 @@ class Verifyd:
                 snap["cache_entries"] = len(self.cache)
                 if self.metrics_port is not None:
                     snap["metrics_port"] = self.metrics_port
+                if self.device_pool is not None:
+                    snap["device_pool"] = self.device_pool.snapshot()
                 return ok(snap)
             if op == "trace":
                 return ok(self.tracer.export())

@@ -84,6 +84,8 @@ def job_profile(res: CheckResult) -> dict:
         )
         if getattr(st, "timeline", None):
             out["timeline"] = st.timeline
+        if getattr(st, "shards", None):
+            out["shards"] = st.shards
     phases = getattr(res, "profile", None)
     if isinstance(phases, dict):
         out["phases"] = phases
@@ -109,6 +111,8 @@ class Scheduler:
         journal=None,
         tracer: Tracer = NULL_TRACER,
         profile: bool = False,
+        device_pool=None,
+        lease_timeout_s: float = 120.0,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -129,6 +133,12 @@ class Scheduler:
         self.journal = journal
         self.tracer = tracer
         self.profile = profile
+        #: device-lease allocator (service/devicepool.py); None = the
+        #: single-chip escalation path, today's behavior
+        self.device_pool = device_pool
+        #: how long an escalation waits for a lease under contention
+        #: before falling back to the unsharded path
+        self.lease_timeout_s = lease_timeout_s
         self._threads: list[threading.Thread] = []
         self._stopping = False
 
@@ -272,6 +282,11 @@ class Scheduler:
         )
         if profile is not None:
             done_fields["profile"] = profile
+        # Per-shard summary rides the done event even without --profile:
+        # the mesh metric families update on every sharded escalation.
+        shards = getattr(getattr(res, "stats", None), "shards", None)
+        if shards:
+            done_fields["shards"] = shards
         self.stats.emit("done", **done_fields)
         out = dict(payload)
         out.update(job=job.id, queue_wait_s=round(queue_wait, 4))
@@ -292,16 +307,18 @@ class Scheduler:
             return res, engine
         if self.device != "off":
             t_dev = time.monotonic()
-            dres = self._escalate_device(job)
+            dres, dev_backend = self._escalate_device(job)
+            t_end = time.monotonic()
             self.tracer.add_span(
                 f"device[{self.device}]",
                 t_dev,
-                time.monotonic(),
+                t_end,
                 tid=job.id,
-                args={"degraded": dres is None},
+                args={"degraded": dres is None, "backend": dev_backend},
             )
+            self._trace_shards(job, dres, t_dev, t_end)
             if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
-                return dres, f"device-{self.device}"
+                return dres, dev_backend
             if dres is None:
                 self.stats.emit("degrade", job=job.id, to="cpu")
         if self.unbounded_close:
@@ -328,29 +345,98 @@ class Scheduler:
         )
         return res, engine
 
-    def _escalate_device(self, job: Job) -> CheckResult | None:
+    def _trace_shards(self, job: Job, res, t0: float, t1: float) -> None:
+        """One span per mesh shard on the job's trace track, spanning the
+        device-escalation window (per-segment timing lives in the profile
+        timeline; the spans carry the per-shard occupancy summary)."""
+        shards = getattr(getattr(res, "stats", None), "shards", None)
+        if not shards:
+            return
+        for s in shards:
+            segs = max(int(s.get("segments") or 0), 1)
+            self.tracer.add_span(
+                f"shard[{s.get('shard')}]",
+                t0,
+                t1,
+                tid=job.id,
+                args={
+                    "device": s.get("device"),
+                    "peak_occupancy": s.get("peak_occupancy"),
+                    "mean_occupancy": round(
+                        (s.get("occupancy_sum") or 0) / segs, 2
+                    ),
+                    "collective_wall_s": s.get("collective_wall_s"),
+                    "skew": s.get("skew"),
+                },
+            )
+
+    def _escalate_device(self, job: Job) -> tuple[CheckResult | None, str]:
+        """Run the device search, leasing a chip set from the pool when one
+        is configured.  Returns ``(result_or_None, backend_string)`` —
+        ``device-mesh[N]`` for a leased N-chip mesh run, the legacy
+        ``device-{mode}`` otherwise."""
         log.info("job %d: CPU budget exhausted; escalating to device", job.id)
-        if self.device == "inline":
-            from ..checker.device import check_device_auto
-            from ..utils.platform import pin_platform
+        backend = f"device-{self.device}"
+        lease = None
+        if self.device_pool is not None:
+            lease = self.device_pool.acquire(
+                shape=job.shape,
+                job=job.id,
+                timeout_s=self.lease_timeout_s,
+            )
+            if lease is not None:
+                backend = f"device-mesh[{lease.size}]"
+                log.info(
+                    "job %d: leased devices %s", job.id, list(lease.indices)
+                )
+            else:
+                # Contention timeout: the single-chip path still answers;
+                # the pool has already emitted lease_timeout.
+                log.warning(
+                    "job %d: no device lease within %.1fs; running unsharded",
+                    job.id,
+                    self.lease_timeout_s,
+                )
+        try:
+            if self.device == "inline":
+                from ..checker.device import check_device_auto
+                from ..utils.platform import pin_platform
 
-            pin_platform()
-            kw = {} if self.device_rows is None else {"device_rows_cap": self.device_rows}
-            if self.profile:
-                kw["profile"] = True
-            return check_device_auto(job.hist, **kw)
-        from .supervise import supervised_device_check
+                pin_platform()
+                kw = {} if self.device_rows is None else {"device_rows_cap": self.device_rows}
+                if self.profile:
+                    kw["profile"] = True
+                if lease is not None:
+                    import jax
 
-        return supervised_device_check(
-            job.events,
-            spool_dir=self.spool_dir,
-            job_id=job.id,
-            attempt_timeout_s=self.attempt_timeout_s,
-            max_restarts=self.max_restarts,
-            device_rows=self.device_rows,
-            log=lambda s: log.info("job %d supervise: %s", job.id, s),
-            tracer=self.tracer,
-        )
+                    from ..parallel.distributed import frontier_mesh
+
+                    ds = jax.devices()
+                    kw["mesh"] = frontier_mesh(
+                        devices=[ds[i] for i in lease.indices]
+                    )
+                    kw["collect_stats"] = True
+                return check_device_auto(job.hist, **kw), backend
+            from .supervise import supervised_device_check
+
+            return (
+                supervised_device_check(
+                    job.events,
+                    spool_dir=self.spool_dir,
+                    job_id=job.id,
+                    attempt_timeout_s=self.attempt_timeout_s,
+                    max_restarts=self.max_restarts,
+                    device_rows=self.device_rows,
+                    devices=lease.indices if lease is not None else None,
+                    profile=self.profile,
+                    log=lambda s: log.info("job %d supervise: %s", job.id, s),
+                    tracer=self.tracer,
+                ),
+                backend,
+            )
+        finally:
+            if lease is not None:
+                self.device_pool.release(lease)
 
     # -- artifact -----------------------------------------------------------
 

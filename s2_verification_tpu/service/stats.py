@@ -68,6 +68,8 @@ class ServiceStats:
             "cache_loaded": 0,
             "job_errors": 0,
             "stats_sink_lost": 0,
+            "leases_granted": 0,
+            "lease_timeouts": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -135,6 +137,41 @@ class ServiceStats:
             "BFS layers searched per profiled job",
             buckets=LAYER_BUCKETS,
         )
+        # Device-pool lease accounting (service/devicepool.py events).
+        self._m_leases_granted = r.counter(
+            "verifyd_leases_granted_total",
+            "Device leases granted to escalating jobs",
+        )
+        self._m_lease_timeouts = r.counter(
+            "verifyd_lease_timeouts_total",
+            "Lease requests that timed out under contention",
+        )
+        self._m_devices_leased = r.gauge(
+            "verifyd_devices_leased", "Devices currently under lease"
+        )
+        self._m_lease_wait = r.histogram(
+            "verifyd_lease_wait_seconds",
+            "Time escalating jobs waited for a device lease",
+            buckets=LATENCY_BUCKETS,
+        )
+        # Per-shard mesh search metrics, labeled by shard index; label
+        # cardinality is bounded by the pool size (≤ device count).
+        self._m_shard_occ = r.gauge(
+            "verifyd_shard_frontier_occupancy",
+            "Peak live frontier rows on each mesh shard (last sharded job)",
+            labelnames=("shard",),
+        )
+        self._m_shard_collective = r.histogram(
+            "verifyd_shard_collective_seconds",
+            "Cross-shard sync wall per sharded job, by shard",
+            buckets=LATENCY_BUCKETS,
+            labelnames=("shard",),
+        )
+        self._m_shard_skew = r.gauge(
+            "verifyd_shard_skew",
+            "Shard peak occupancy over mesh mean (1.0 = balanced)",
+            labelnames=("shard",),
+        )
 
     # -- event stream -------------------------------------------------------
 
@@ -194,6 +231,17 @@ class ServiceStats:
         elif event == "degrade":
             self._counters["degraded"] += 1
             self._m_degraded.inc()
+        elif event == "lease_grant":
+            self._counters["leases_granted"] += 1
+            self._m_leases_granted.inc()
+            self._m_devices_leased.set(int(fields.get("in_use", 0)))
+            if "wait_s" in fields:
+                self._m_lease_wait.observe(float(fields["wait_s"]))
+        elif event == "lease_release":
+            self._m_devices_leased.set(int(fields.get("in_use", 0)))
+        elif event == "lease_timeout":
+            self._counters["lease_timeouts"] += 1
+            self._m_lease_timeouts.inc()
         elif event == "auth_reject":
             self._counters["auth_rejects"] += 1
             self._m_auth_rejects.inc()
@@ -235,6 +283,17 @@ class ServiceStats:
             profile = fields.get("profile")
             if isinstance(profile, dict) and "layers" in profile:
                 self._m_layers.observe(float(profile["layers"]))
+            for s in fields.get("shards") or []:
+                if not isinstance(s, dict):
+                    continue
+                shard = str(s.get("shard", "?"))
+                self._m_shard_occ.set(
+                    float(s.get("peak_occupancy", 0)), shard=shard
+                )
+                self._m_shard_collective.observe(
+                    float(s.get("collective_wall_s", 0.0)), shard=shard
+                )
+                self._m_shard_skew.set(float(s.get("skew", 1.0)), shard=shard)
 
     def set_queue_depth(self, depth: int) -> None:
         """Point-in-time admission-queue depth (daemon after put, workers

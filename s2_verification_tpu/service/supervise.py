@@ -33,21 +33,41 @@ __all__ = ["supervised_device_check"]
 
 
 def _result_to_json(res: CheckResult) -> dict:
-    return {
+    out = {
         "outcome": res.outcome.value,
         "linearization": res.linearization,
         "deepest": list(res.deepest),
         "steps": res.steps,
     }
+    st = getattr(res, "stats", None)
+    if st is not None:
+        # Search stats (incl. the per-shard summary and profile timeline of
+        # a mesh run) cross the process boundary with the verdict: the
+        # parent's metrics/tracer/viz must see what the child measured.
+        import dataclasses
+
+        out["stats"] = dataclasses.asdict(st)
+    return out
 
 
 def _result_from_json(obj: dict) -> CheckResult:
-    return CheckResult(
+    res = CheckResult(
         CheckOutcome(obj["outcome"]),
         linearization=obj.get("linearization"),
         deepest=list(obj.get("deepest") or []),
         steps=int(obj.get("steps") or 0),
     )
+    st = obj.get("stats")
+    if isinstance(st, dict):
+        import dataclasses
+
+        from ..checker.frontier import FrontierStats
+
+        known = {f.name for f in dataclasses.fields(FrontierStats)}
+        res.stats = FrontierStats(  # type: ignore[attr-defined]
+            **{k: v for k, v in st.items() if k in known}
+        )
+    return res
 
 
 def supervised_device_check(
@@ -58,6 +78,8 @@ def supervised_device_check(
     attempt_timeout_s: float = 900.0,
     max_restarts: int = 2,
     device_rows: int | None = None,
+    devices: tuple[int, ...] | list[int] | None = None,
+    profile: bool = False,
     probe: bool | None = None,
     log=None,
     tracer=None,
@@ -71,6 +93,17 @@ def supervised_device_check(
     (probing a CPU "backend" is pointless and slow).  ``tracer`` (a
     :class:`~..obs.Tracer`) records the driver's attempt/probe spans on
     the job's trace track.
+
+    ``devices`` (a :class:`~.devicepool.DevicePool` grant): offsets into
+    the child's ``jax.devices()`` list; the child builds a frontier mesh
+    over exactly those chips and runs the search sharded, collecting the
+    per-shard stats the parent's metrics need.  Indices travel as argv —
+    the supervising daemon never resolves device objects itself (a dead
+    backend hangs init; ``checker/resilient.py``).  Because the child
+    re-places the checkpointed frontier onto whatever mesh its argv
+    names, a restart after a re-grant onto a *different* chip set resumes
+    the same snapshot.  ``profile=True`` makes the child record the
+    per-segment timeline (rides back in the result JSON).
     """
     from ..checker.resilient import default_probe_cmd, drive
     from ..obs.trace import NULL_TRACER
@@ -95,6 +128,10 @@ def supervised_device_check(
     ]
     if device_rows is not None:
         cmd.append(str(device_rows))
+    if devices is not None:
+        cmd.append("devices=" + ",".join(str(int(i)) for i in devices))
+    if profile:
+        cmd.append("profile=1")
     try:
         outcome = drive(
             cmd,
@@ -122,7 +159,18 @@ def supervised_device_check(
 
 def _child_main(argv: list[str]) -> int:
     hist_path, ckpt_path, out_path = argv[:3]
-    device_rows = int(argv[3]) if len(argv) > 3 else None
+    # Trailing argv: a bare int is the legacy device_rows cap; `key=value`
+    # extras carry the mesh grant and the profile flag.
+    device_rows: int | None = None
+    devices: list[int] | None = None
+    profile = False
+    for extra in argv[3:]:
+        if extra.startswith("devices="):
+            devices = [int(s) for s in extra[len("devices=") :].split(",") if s]
+        elif extra.startswith("profile="):
+            profile = extra[len("profile=") :] == "1"
+        else:
+            device_rows = int(extra)
 
     # Same pin discipline as checker/resilient._PROBE_CODE: the axon
     # sitecustomize hook overrides JAX_PLATFORMS, so re-pin via config API.
@@ -137,7 +185,25 @@ def _child_main(argv: list[str]) -> int:
     from ..utils import events as ev
 
     hist = prepare(ev.read_history(hist_path))
-    kw = {} if device_rows is None else {"device_rows_cap": device_rows}
+    kw: dict = {} if device_rows is None else {"device_rows_cap": device_rows}
+    if profile:
+        kw["profile"] = True
+    if devices is not None:
+        import jax
+
+        from ..parallel.distributed import frontier_mesh
+
+        ds = jax.devices()
+        missing = [i for i in devices if i >= len(ds)]
+        if missing:
+            raise SystemExit(
+                f"device grant {devices} exceeds the {len(ds)} visible "
+                "devices (check XLA_FLAGS / the platform pin)"
+            )
+        # Mesh runs always collect stats: the parent's per-shard metric
+        # families are fed from the result JSON, profile or not.
+        kw["mesh"] = frontier_mesh(devices=[ds[i] for i in devices])
+        kw["collect_stats"] = True
     res = check_device_auto(hist, checkpoint_path=ckpt_path, **kw)
     tmp = f"{out_path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["pin_platform"]
+__all__ = ["pin_platform", "ensure_host_device_count"]
 
 
 def pin_platform() -> None:
@@ -23,3 +23,27 @@ def pin_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", plat)
+
+
+def ensure_host_device_count(n: int) -> None:
+    """Provision ``n`` virtual CPU devices via ``XLA_FLAGS`` (env mutation,
+    so escalation children inherit the same topology).  Call before first
+    jax use in this process — the flag is read at backend init.
+
+    When the host has fewer cores than devices, XLA's per-device Eigen
+    thread pools oversubscribe the machine badly; pin them to one thread
+    each in that case (same guard as tests/conftest.py and
+    ``__graft_entry__.dryrun_multichip``).
+    """
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={int(n)}")
+    if (os.cpu_count() or 1) < n and not any(
+        "xla_cpu_multi_thread_eigen" in f for f in flags
+    ):
+        flags.append("--xla_cpu_multi_thread_eigen=false")
+        flags.append("intra_op_parallelism_threads=1")
+    os.environ["XLA_FLAGS"] = " ".join(flags)

@@ -1,0 +1,132 @@
+"""Multi-chip serving gate: sharded verdict == single-chip verdict.
+
+The `make mesh` target.  Provisions 8 virtual CPU devices
+(``--xla_force_host_platform_device_count``), boots verifyd twice —
+``mesh_devices=8`` and ``mesh_devices=1`` — and drives the same
+adversarial history through the **supervised** escalation path of each
+(real child process, device-lease grant on argv, sharded search,
+checkpoint spool).  Asserts:
+
+1. both daemons answer, with backend ``device-mesh[N]``;
+2. the verdicts agree — sharding must never change an answer;
+3. the 8-device daemon's registry carries the per-shard metric families.
+
+The CPU pass is stubbed to always return UNKNOWN (same trick as the
+service tests): a wall-clock budget races the host, a stub never does —
+every submission deterministically escalates.
+
+Exit 0 on success, 1 with a diagnostic.  CPU-only; a couple of child
+processes, so expect ~a minute on a laptop-class host.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MESH_N = 8
+
+
+def _fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from s2_verification_tpu.utils.platform import ensure_host_device_count
+
+    # Before any jax use in this process *and* exported to the spawned
+    # escalation children.
+    ensure_host_device_count(MESH_N)
+
+    from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+    from s2_verification_tpu.collector.collect import (
+        CollectConfig,
+        collect_history,
+    )
+    from s2_verification_tpu.service import scheduler as sched_mod
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+    from s2_verification_tpu.utils import events as ev
+
+    hist = collect_history(
+        CollectConfig(
+            num_concurrent_clients=4,
+            num_ops_per_client=5,
+            workflow="adversarial",
+            seed=13,
+        )
+    )
+    buf = io.StringIO()
+    ev.write_history(hist, buf)
+    text = buf.getvalue()
+
+    real_cpu_check = sched_mod._cpu_check
+    sched_mod._cpu_check = lambda h, budget, profile=False: (
+        CheckResult(CheckOutcome.UNKNOWN),
+        "native",
+    )
+    answers = {}
+    try:
+        for n in (MESH_N, 1):
+            with tempfile.TemporaryDirectory(prefix=f"mesh-check-{n}-") as d:
+                cfg = VerifydConfig(
+                    socket_path=os.path.join(d, "verifyd.sock"),
+                    out_dir=os.path.join(d, "viz"),
+                    spool_dir=os.path.join(d, "spool"),
+                    no_viz=True,
+                    stats_log=None,
+                    device="supervised",
+                    mesh_devices=n,
+                )
+                with Verifyd(cfg) as daemon:
+                    client = VerifydClient(cfg.socket_path)
+                    reply = client.submit(text, client="mesh-check")
+                    answers[n] = reply
+                    backend = str(reply.get("backend"))
+                    if not backend.startswith("device-mesh["):
+                        return _fail(
+                            f"mesh_devices={n}: backend {backend!r}, "
+                            "expected device-mesh[...] (did the escalation "
+                            "degrade to CPU?)"
+                        )
+                    if n > 1:
+                        fams = daemon.registry.render()
+                        for fam in (
+                            "verifyd_shard_frontier_occupancy",
+                            "verifyd_shard_collective_seconds",
+                            "verifyd_shard_skew",
+                            "verifyd_leases_granted_total",
+                        ):
+                            if fam not in fams:
+                                return _fail(
+                                    f"mesh_devices={n}: family {fam} "
+                                    "missing from the registry"
+                                )
+                print(
+                    f"# mesh_devices={n}: verdict {reply.get('verdict')} "
+                    f"via {backend} in {reply.get('wall_s')}s",
+                    file=sys.stderr,
+                )
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+
+    if answers[MESH_N].get("verdict") != answers[1].get("verdict"):
+        return _fail(
+            f"sharded verdict {answers[MESH_N].get('verdict')} != "
+            f"single-chip verdict {answers[1].get('verdict')}"
+        )
+    print(
+        f"mesh check OK: verdict {answers[1].get('verdict')} identical on "
+        f"{answers[MESH_N].get('backend')} and {answers[1].get('backend')}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -40,6 +40,20 @@ REQUIRED_FAMILIES = (
     "verifyd_wall_seconds",
 )
 
+#: families a mesh-pool daemon must additionally expose after a sharded
+#: escalation (ISSUE: per-shard metrics in the one ServiceStats registry)
+REQUIRED_SHARD_FAMILIES = (
+    "verifyd_shard_frontier_occupancy",
+    "verifyd_shard_collective_seconds",
+    "verifyd_shard_skew",
+    "verifyd_leases_granted_total",
+    "verifyd_devices_leased",
+    "verifyd_lease_wait_seconds",
+)
+
+#: virtual CPU devices for the mesh phase (set before first jax use)
+MESH_N = 2
+
 
 def _fail(msg: str) -> int:
     print(f"FAIL: {msg}", file=sys.stderr)
@@ -95,6 +109,11 @@ def main() -> int:
     from s2_verification_tpu.service.client import VerifydClient
     from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
     from s2_verification_tpu.utils import events as ev
+    from s2_verification_tpu.utils.platform import ensure_host_device_count
+
+    # The mesh phase shards escalations over MESH_N virtual CPU devices;
+    # XLA reads the flag at backend init, so provision before any jax use.
+    ensure_host_device_count(MESH_N)
 
     texts = []
     for seed, (clients, ops) in enumerate([(2, 8), (3, 10), (2, 12)]):
@@ -244,9 +263,97 @@ def main() -> int:
             if not ok_nest:
                 return _fail("no admit span contains a prepare span")
 
+    # -- mesh phase: per-shard families after a sharded escalation ----------
+    from s2_verification_tpu.service import scheduler as sched_mod
+    from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+
+    # Deterministic escalation forcing (same trick as the service tests):
+    # a wall-clock budget races the host, a stubbed CPU pass never does.
+    real_cpu_check = sched_mod._cpu_check
+    sched_mod._cpu_check = lambda hist, budget, profile=False: (
+        CheckResult(CheckOutcome.UNKNOWN),
+        "native",
+    )
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-mesh-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="inline",
+                metrics_port=0,
+                mesh_devices=MESH_N,
+            )
+            # Wide enough (4 chains) that the sizing policy grants the
+            # whole 2-device pool — the scrape must show real sharding.
+            mesh_hist = collect_history(
+                CollectConfig(
+                    num_concurrent_clients=4, num_ops_per_client=6, seed=11
+                )
+            )
+            buf = io.StringIO()
+            ev.write_history(mesh_hist, buf)
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                reply = client.submit(buf.getvalue(), client="obs-mesh")
+                if reply.get("verdict") not in (0, 1, 2):
+                    return _fail(f"mesh job failed: {reply}")
+                backend = str(reply.get("backend"))
+                if not backend.startswith("device-mesh["):
+                    return _fail(
+                        f"mesh escalation reported backend {backend!r}, "
+                        "expected device-mesh[N]"
+                    )
+                body = (
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{daemon.metrics_port}/metrics",
+                        timeout=5,
+                    )
+                    .read()
+                    .decode("utf-8")
+                )
+                kinds = _parse_families(body)
+                for fam in REQUIRED_SHARD_FAMILIES:
+                    if fam not in kinds:
+                        return _fail(
+                            f"mesh daemon missing family {fam} "
+                            f"(have: {sorted(k for k in kinds if 'shard' in k or 'lease' in k)})"
+                        )
+                # Shard label cardinality is bounded by the pool size.
+                shard_labels = {
+                    line.split('shard="', 1)[1].split('"', 1)[0]
+                    for line in body.splitlines()
+                    if line.startswith("verifyd_shard") and 'shard="' in line
+                }
+                if not shard_labels:
+                    return _fail("per-shard series carry no shard label")
+                if len(shard_labels) > MESH_N:
+                    return _fail(
+                        f"shard label cardinality {len(shard_labels)} exceeds "
+                        f"the {MESH_N}-device pool: {sorted(shard_labels)}"
+                    )
+                wall_series = _histogram_series(body, "verifyd_wall_seconds")
+                if not any("device-mesh[" in labels for labels in wall_series):
+                    return _fail(
+                        f"verifyd_wall_seconds has no device-mesh backend "
+                        f"series: {sorted(wall_series)}"
+                    )
+                snap = client.stats()
+                pool = snap.get("device_pool")
+                if not isinstance(pool, dict) or pool.get("total") != MESH_N:
+                    return _fail(f"stats op lacks the device_pool snapshot: {pool}")
+                if not pool.get("granted"):
+                    return _fail(f"device pool granted no leases: {pool}")
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
-        f"{len(spans)} spans, {len(profiled)} profiled jobs"
+        f"{len(spans)} spans, {len(profiled)} profiled jobs, "
+        f"{len(REQUIRED_SHARD_FAMILIES)} shard/lease families over "
+        f"{len(shard_labels)} shards ({backend})"
     )
     return 0
 

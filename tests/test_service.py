@@ -436,7 +436,9 @@ def test_wedged_device_job_degrades_to_cpu(tmp_path, monkeypatch):
 
     monkeypatch.setattr(sched_mod, "_cpu_check", budget_always_expires)
     monkeypatch.setattr(
-        sched_mod.Scheduler, "_escalate_device", lambda self, job: None
+        sched_mod.Scheduler,
+        "_escalate_device",
+        lambda self, job: (None, "device-supervised"),
     )
 
     cfg = _daemon_cfg(
