@@ -541,18 +541,59 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         trace_capacity=args.trace_capacity,
         profile=args.profile,
         mesh_devices=mesh_devices,
+        log_format=args.log_format,
+        slo_target=args.slo_target,
+        slo_latency_target_s=args.slo_latency_target,
     )
     daemon = Verifyd(cfg)
+
+    # Route stdlib-logging diagnostics (this module, scheduler, supervise,
+    # resilient) through the daemon's structured logger so every line —
+    # events and diagnostics alike — shares one format and one stream.
+    from .obs.log import StructuredHandler
+
+    pkg_log = logging.getLogger("s2_verification_tpu")
+    handler = StructuredHandler(daemon.logger)
+    pkg_log.addHandler(handler)
+    pkg_log.propagate = False
 
     import signal as _signal
 
     def _stop(signum, frame):
         log.info("signal %d: stopping verifyd", signum)
+        # Black-box dump before teardown: SIGTERM is how orchestration
+        # kills a daemon, and the flight tail is the post-mortem story.
+        daemon.dump_flight(
+            "sigterm" if signum == _signal.SIGTERM else "sigint"
+        )
         daemon.request_stop()
 
     for sig in (_signal.SIGINT, _signal.SIGTERM):
         _signal.signal(sig, _stop)
-    return daemon.serve_forever()
+    try:
+        return daemon.serve_forever()
+    finally:
+        pkg_log.removeHandler(handler)
+        pkg_log.propagate = True
+
+
+def _cmd_doctor(args: argparse.Namespace) -> int:
+    """Read-only post-mortem of a (dead) daemon's --state-dir."""
+    from .obs.flight import postmortem, render_postmortem
+
+    if not os.path.isdir(args.state_dir):
+        log.error("state dir %s does not exist", args.state_dir)
+        return USAGE_EXIT
+    pm = postmortem(args.state_dir, tail=max(1, args.tail))
+    if args.json:
+        import json as _json
+
+        print(_json.dumps(pm, default=str), flush=True)
+    else:
+        print(render_postmortem(pm, tail=max(1, args.tail)), end="", flush=True)
+    # Exit codes mirror the verdict: 0 clean shutdown, 1 unclean death —
+    # scriptable ("did the last run die?") without parsing the report.
+    return 0 if pm["clean_shutdown"] else 1
 
 
 def _cmd_trace(args: argparse.Namespace) -> int:
@@ -582,6 +623,9 @@ def _cmd_trace(args: argparse.Namespace) -> int:
 
     import json as _json
 
+    warning = (trace.get("otherData") or {}).get("warning")
+    if warning:
+        log.warning("%s", warning)
     text = _json.dumps(trace)
     if args.out == "-":
         print(text, flush=True)
@@ -669,11 +713,14 @@ def _cmd_submit(args: argparse.Namespace) -> int:
             "ops": reply.get("ops"),
             "cached": reply.get("cached", False),
             "shape": reply.get("shape"),
+            "trace_id": reply.get("trace_id"),
         }
         print(_json.dumps(line), flush=True)
     art = reply.get("artifact")
     if art:
         log.info("visualization: %s", art)
+    if reply.get("trace_id"):
+        log.info("trace_id: %s", reply["trace_id"])
     verdict = reply.get("verdict")
     outcome = reply.get("outcome")
     if verdict == 0:
@@ -919,7 +966,55 @@ def build_parser() -> argparse.ArgumentParser:
         "default: off — single-chip escalation). Under JAX_PLATFORMS=cpu "
         "a numeric N provisions N virtual devices via XLA_FLAGS.",
     )
+    s.add_argument(
+        "--log-format",
+        default="text",
+        choices=["text", "json"],
+        help="structured-log line format for daemon diagnostics and the "
+        "stats-log '-' fallback: human 'text' (default) or one JSON "
+        "object per line for log shippers",
+    )
+    s.add_argument(
+        "--slo-target",
+        type=float,
+        default=0.99,
+        metavar="FRACTION",
+        help="SLO availability target driving /healthz and slo_breach "
+        "events (default 0.99; 1.0 disables burn-rate math)",
+    )
+    s.add_argument(
+        "--slo-latency-target",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help="end-to-end p95 latency target on the 1m window for "
+        "/healthz degradation (default 5.0)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
+
+    d = sub.add_parser(
+        "doctor",
+        help="post-mortem a dead verifyd's --state-dir: flight-recorder "
+        "tail, orphaned journal entries, open device leases, slowest "
+        "spans, and the SLO picture at death",
+    )
+    d.add_argument(
+        "--state-dir",
+        required=True,
+        help="the dead daemon's durable-state directory",
+    )
+    d.add_argument(
+        "--tail",
+        type=int,
+        default=20,
+        help="flight-recorder records to show (default 20)",
+    )
+    d.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the full post-mortem as JSON instead of the report",
+    )
+    d.set_defaults(fn=_cmd_doctor)
 
     t = sub.add_parser(
         "trace",

@@ -1,25 +1,49 @@
-"""Zero-dependency observability: spans, metrics, and a /metrics endpoint.
+"""Zero-dependency observability: spans, metrics, health, and post-mortems.
 
 The obs package is the instrumentation layer threaded through the checker
 engines and verifyd hot paths:
 
 - ``trace``   — a thread-safe Tracer recording nested spans into a bounded
-                ring, exportable as Chrome trace_event JSON (Perfetto).
+                ring, exportable as Chrome trace_event JSON (Perfetto);
+                stitches child-process rings via clock rebasing.
+- ``context`` — distributed trace ids (W3C-style), protocol-frame
+                propagation helpers, and the clock-rebase math.
 - ``metrics`` — counter / gauge / histogram registry rendering Prometheus
                 text exposition format 0.0.4.
-- ``httpd``   — stdlib-only HTTP listener serving GET /metrics.
+- ``health``  — SLO engine: rolling multi-window availability, latency
+                quantiles, and error-budget burn rates over the
+                ServiceStats event stream.
+- ``httpd``   — stdlib-only HTTP listener serving GET /metrics, a real
+                /healthz (200 ok / 503 degraded), and /slo.
+- ``log``     — structured logger (JSON or text lines) with trace_id /
+                job_id correlation fields.
+- ``flight``  — flight recorder: bounded on-disk ring of recent events +
+                spans (seglog-backed) and the doctor's post-mortem reader.
 
 Everything here is stdlib-only by design: the daemon must stay deployable
 on a bare TPU host image with no pip access.
 """
 
+from .context import new_trace_id, valid_trace_id
+from .flight import FlightRecorder, postmortem, read_flight, render_postmortem
+from .health import SLOConfig, SLOHealth
+from .log import StructuredLogger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .trace import Tracer
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SLOConfig",
+    "SLOHealth",
+    "StructuredLogger",
     "Tracer",
+    "new_trace_id",
+    "postmortem",
+    "read_flight",
+    "render_postmortem",
+    "valid_trace_id",
 ]

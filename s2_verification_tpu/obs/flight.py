@@ -1,0 +1,329 @@
+"""Flight recorder: bounded on-disk ring of recent events + spans.
+
+An in-memory span ring and a JSONL stats sink are great while the daemon
+is alive — and worthless the moment it is SIGKILLed, OOMed, or wedged.
+The flight recorder is the black box: a small, *bounded* on-disk ring
+(``utils/seglog.SegmentLog`` with ``max_segments``, the same CRC-checked
+storage discipline as the verdict cache) under
+``<state_dir>/flight/`` that continuously absorbs
+
+- every ServiceStats event (fed by ServiceStats outside its sink lock),
+- every completed tracer span (via ``Tracer.span_hook``),
+- explicit **dump** records on SIGTERM / daemon close / SLO breach,
+  carrying a full SLO snapshot at that instant.
+
+Each record is one JSON object ``{"k": "ev"|"span"|"dump", "t": wall,
+...}``.  Because every append is flushed, the tail survives SIGKILL up
+to the last OS write — exactly the property the doctor needs.
+
+:func:`postmortem` is the read side: point it at a dead daemon's
+``--state-dir`` and it reconstructs the story — last events, orphaned
+journal entries, device-pool leases still open at death, slowest spans,
+the SLO picture (replayed from recorded events, which carry their own
+timestamps), and whether the death looks clean (last record is a
+shutdown dump) or not.  The ``doctor`` CLI subcommand is a thin wrapper
+over it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.seglog import SegmentLog
+from .health import SLOConfig, SLOHealth
+
+__all__ = ["FlightRecorder", "read_flight", "postmortem", "render_postmortem"]
+
+FLIGHT_SUBDIR = "flight"
+
+
+class FlightRecorder:
+    """Continuously-flushed bounded ring of observability records."""
+
+    def __init__(
+        self,
+        directory: str,
+        *,
+        max_segment_bytes: int = 256 << 10,
+        max_segments: int = 8,
+        fsync: bool = False,
+    ) -> None:
+        self._log = SegmentLog(
+            directory,
+            max_segment_bytes=max_segment_bytes,
+            max_segments=max_segments,
+            fsync=fsync,
+        )
+        self._closed = False
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        if self._closed:
+            return
+        try:
+            self._log.append(
+                json.dumps(rec, separators=(",", ":"), default=str).encode("utf-8")
+            )
+        except (OSError, ValueError, TypeError):
+            pass  # the black box must never take the plane down
+
+    def record_event(self, ev: Dict[str, Any]) -> None:
+        """Absorb one ServiceStats event line (already has ``t``/``event``)."""
+        self._append({"k": "ev", **ev})
+
+    def record_span(self, span: Dict[str, Any]) -> None:
+        """Absorb one completed tracer span (Tracer.span_hook target)."""
+        if span.get("ph") != "X":
+            return
+        self._append({"k": "span", "t": round(time.time(), 6), **span})
+
+    def dump(self, reason: str, **extra: Any) -> None:
+        """Write a marker record (shutdown / sigterm / slo_breach) with
+        whatever context the caller attaches (usually ``slo=snapshot``)."""
+        self._append({"k": "dump", "t": round(time.time(), 6), "reason": reason, **extra})
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._log.close()
+
+
+# --------------------------------------------------------------- read side
+
+
+def read_flight(state_dir: str) -> List[Dict[str, Any]]:
+    """Replay a state dir's flight ring → record dicts, oldest first.
+    Read-only: tolerates a missing ring (old daemon) by returning []."""
+    directory = os.path.join(state_dir, FLIGHT_SUBDIR)
+    if not os.path.isdir(directory):
+        return []
+    log = SegmentLog(directory)
+    out: List[Dict[str, Any]] = []
+    try:
+        for payload in log.replay():
+            try:
+                rec = json.loads(payload)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    finally:
+        log.close()
+    return out
+
+
+def _journal_orphans(state_dir: str) -> List[Dict[str, Any]]:
+    journal_dir = os.path.join(state_dir, "journal")
+    if not os.path.isdir(journal_dir):
+        return []
+    # local import: journal pulls in the service package; doctor must not
+    # need a running daemon's deps beyond stdlib + seglog
+    from ..service.journal import JobJournal
+
+    j = JobJournal(journal_dir)
+    try:
+        return j.orphans()
+    finally:
+        j.close()
+
+
+def postmortem(
+    state_dir: str,
+    *,
+    tail: int = 40,
+    slow: int = 10,
+    slo_config: Optional[SLOConfig] = None,
+) -> Dict[str, Any]:
+    """Reconstruct a dead daemon's last moments from its state dir.
+
+    Pure read: never creates directories, never appends.  Returns a dict
+    with the flight tail, orphaned journal entries, open leases, slowest
+    spans, breach dumps, the replayed SLO picture at death, and a
+    clean/unclean verdict.
+    """
+    records = read_flight(state_dir)
+    events = [r for r in records if r.get("k") == "ev"]
+    spans = [r for r in records if r.get("k") == "span"]
+    dumps = [r for r in records if r.get("k") == "dump"]
+
+    # Open leases: grants never matched by a release/timeout of the same job.
+    open_leases: Dict[Any, Dict[str, Any]] = {}
+    for ev in events:
+        name = ev.get("ev") or ev.get("event")
+        if name == "lease_grant":
+            open_leases[ev.get("job")] = ev
+        elif name in ("lease_release", "lease_timeout"):
+            open_leases.pop(ev.get("job"), None)
+
+    # SLO at death: replay recorded request-outcome events (each carries
+    # its own wall ``t``) into a fresh engine, evaluated at the last
+    # recorded instant so the windows reflect the moment of death rather
+    # than "now".
+    last_t = max((float(r.get("t", 0.0)) for r in records), default=time.time())
+    engine = SLOHealth(slo_config, time_fn=lambda: last_t)
+    for ev in events:
+        engine.observe_event(ev)
+    slo_at_death = engine.snapshot()
+
+    slowest = sorted(
+        (s for s in spans if isinstance(s.get("dur"), (int, float))),
+        key=lambda s: -float(s["dur"]),
+    )[:slow]
+
+    breaches = [d for d in dumps if d.get("reason") == "slo_breach"]
+    last = records[-1] if records else None
+    clean = bool(
+        last
+        and last.get("k") == "dump"
+        and last.get("reason") in ("shutdown", "sigterm", "sigint")
+    )
+
+    return {
+        "state_dir": state_dir,
+        "records": len(records),
+        "events": len(events),
+        "spans": len(spans),
+        "dumps": dumps,
+        "breaches": breaches,
+        "clean_shutdown": clean,
+        "last_record": last,
+        "tail": records[-tail:],
+        "orphans": _journal_orphans(state_dir),
+        "open_leases": list(open_leases.values()),
+        "slowest_spans": slowest,
+        "slo_at_death": slo_at_death,
+    }
+
+
+def _fmt_t(t: Any) -> str:
+    try:
+        return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(float(t)))
+    except (TypeError, ValueError, OverflowError):
+        return "?"
+
+
+def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
+    """Human-readable doctor report (the --json flag skips this)."""
+    lines: List[str] = []
+    add = lines.append
+    add("== verifyd doctor: %s ==" % pm["state_dir"])
+    add(
+        "flight ring: %d records (%d events, %d spans, %d dumps)"
+        % (pm["records"], pm["events"], pm["spans"], len(pm["dumps"]))
+    )
+    verdict = "clean shutdown" if pm["clean_shutdown"] else "UNCLEAN DEATH"
+    last = pm["last_record"]
+    if last is not None:
+        add(
+            "last record: %s %s at %s  -> %s"
+            % (
+                last.get("k"),
+                last.get("reason") or last.get("ev") or last.get("name", ""),
+                _fmt_t(last.get("t")),
+                verdict,
+            )
+        )
+    else:
+        add("last record: none (empty or missing flight ring) -> %s" % verdict)
+
+    if pm["breaches"]:
+        add("")
+        add("-- SLO breaches recorded (%d) --" % len(pm["breaches"]))
+        for b in pm["breaches"][-5:]:
+            reasons = b.get("breach", {}).get("reasons") or b.get("reasons") or []
+            why = "; ".join(
+                "%s burn=%.1f on %s"
+                % (r.get("kind"), r.get("burn_rate", 0.0), r.get("window"))
+                for r in reasons
+            )
+            add("  %s  %s" % (_fmt_t(b.get("t")), why or "(no detail)"))
+
+    slo = pm["slo_at_death"]
+    add("")
+    add(
+        "-- SLO at death (target %.3f) --  %s"
+        % (
+            slo["availability_target"],
+            "healthy" if slo["healthy"] else "DEGRADED: %s" % json.dumps(slo["reasons"]),
+        )
+    )
+    for wname, w in slo["windows"].items():
+        add(
+            "  %-4s avail=%.4f burn=%.1f good=%d bad=%d p95=%s"
+            % (
+                wname,
+                w["availability"],
+                w["burn_rate"],
+                w["good"],
+                w["bad"],
+                w["latency"].get("p95"),
+            )
+        )
+
+    if pm["orphans"]:
+        add("")
+        add("-- orphaned journal entries (accepted, never closed): %d --" % len(pm["orphans"]))
+        for rec in pm["orphans"][:10]:
+            add(
+                "  job=%s fp=%s client=%s"
+                % (rec.get("job"), str(rec.get("fp", ""))[:16], rec.get("client"))
+            )
+
+    if pm["open_leases"]:
+        add("")
+        add("-- device-pool leases open at death: %d --" % len(pm["open_leases"]))
+        for ev in pm["open_leases"]:
+            add(
+                "  job=%s devices=%s granted at %s"
+                % (ev.get("job"), ev.get("devices"), _fmt_t(ev.get("t")))
+            )
+
+    if pm["slowest_spans"]:
+        add("")
+        add("-- slowest spans --")
+        for s in pm["slowest_spans"]:
+            add(
+                "  %8.1f ms  %-20s tid=%s %s"
+                % (
+                    float(s.get("dur", 0.0)) / 1000.0,
+                    s.get("name"),
+                    s.get("tid"),
+                    json.dumps(s.get("args", {}), sort_keys=True) if s.get("args") else "",
+                )
+            )
+
+    if pm["tail"]:
+        add("")
+        add("-- flight tail (last %d of %d) --" % (min(tail, len(pm["tail"])), pm["records"]))
+        for rec in pm["tail"][-tail:]:
+            kind = rec.get("k")
+            if kind == "ev":
+                body = rec.get("ev") or rec.get("event") or "?"
+                detail = {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("k", "t", "ev", "event")
+                    and not isinstance(v, (dict, list))
+                }
+                add(
+                    "  %s ev   %-14s %s"
+                    % (_fmt_t(rec.get("t")), body, json.dumps(detail, sort_keys=True, default=str))
+                )
+            elif kind == "span":
+                add(
+                    "  %s span %-14s dur=%.1fms tid=%s"
+                    % (
+                        _fmt_t(rec.get("t")),
+                        rec.get("name", "?"),
+                        float(rec.get("dur", 0.0)) / 1000.0,
+                        rec.get("tid"),
+                    )
+                )
+            else:
+                add(
+                    "  %s DUMP %s"
+                    % (_fmt_t(rec.get("t")), rec.get("reason", "?"))
+                )
+    return "\n".join(lines) + "\n"

@@ -1,55 +1,90 @@
-"""Stdlib-only HTTP listener serving GET /metrics for a MetricsRegistry.
+"""Stdlib-only HTTP listener: /metrics, /healthz, /slo.
 
 One ThreadingHTTPServer on a daemon thread per daemon process.  Port 0
 binds an ephemeral port (the bound port is readable via ``.port`` — used
-by tests and `make obs`).  Anything other than GET /metrics (and a
-convenience GET /healthz) is a 404; there is deliberately no write
-surface here.
+by tests and `make obs`).  There is deliberately no write surface here.
+
+Endpoints:
+
+- ``GET /metrics`` — Prometheus text exposition.  When a health engine
+  is attached, its gauges are refreshed *before* rendering so a scrape
+  never sees stale SLO numbers.
+- ``GET /healthz`` — a *real* health check: 200 with ``{"status":"ok"}``
+  when within SLO, **503** with ``{"status":"degraded","reasons":[…]}``
+  when a burn threshold or latency target is blown.  Load balancers key
+  off the status code; humans and alerting key off the JSON reasons.
+  Without a health engine it degrades to the old static 200 "ok".
+- ``GET /slo`` — the full SLO snapshot (all windows, quantiles, burn
+  rates, breach history) as JSON.
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import TYPE_CHECKING, Optional
 
 from .metrics import MetricsRegistry
+
+if TYPE_CHECKING:  # pragma: no cover - import cycle guard (health ← metrics)
+    from .health import SLOHealth
 
 __all__ = ["MetricsServer", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+_JSON_TYPE = "application/json; charset=utf-8"
 
 
 class MetricsServer:
-    """Background /metrics exposition server bound to ``host:port``."""
+    """Background /metrics + /healthz + /slo server bound to ``host:port``."""
 
     def __init__(
         self,
         registry: MetricsRegistry,
         port: int,
         host: str = "127.0.0.1",
+        *,
+        health: "Optional[SLOHealth]" = None,
     ) -> None:
         self.registry = registry
+        self.health = health
 
         server = self
 
         class _Handler(BaseHTTPRequestHandler):
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self) -> None:  # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = server.registry.render().encode("utf-8")
-                    self.send_response(200)
-                    self.send_header("Content-Type", CONTENT_TYPE)
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    if server.health is not None:
+                        server.health.refresh()
+                    self._reply(
+                        200, server.registry.render().encode("utf-8"), CONTENT_TYPE
+                    )
                 elif path == "/healthz":
-                    body = b"ok\n"
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/plain; charset=utf-8")
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    if server.health is None:
+                        self._reply(200, b"ok\n", "text/plain; charset=utf-8")
+                        return
+                    healthy, body = server.health.healthz()
+                    self._reply(
+                        200 if healthy else 503,
+                        (json.dumps(body, sort_keys=True) + "\n").encode("utf-8"),
+                        _JSON_TYPE,
+                    )
+                elif path == "/slo" and server.health is not None:
+                    snap = server.health.refresh()
+                    self._reply(
+                        200,
+                        (json.dumps(snap, sort_keys=True) + "\n").encode("utf-8"),
+                        _JSON_TYPE,
+                    )
                 else:
                     self.send_error(404)
 

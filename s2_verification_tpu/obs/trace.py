@@ -24,7 +24,9 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .context import rebase_spans
 
 __all__ = ["Tracer", "NULL_TRACER"]
 
@@ -41,18 +43,48 @@ class Tracer:
         self.capacity = max(0, int(capacity))
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity or 1)
+        # Both bases are read at the same instant so wall_base can serve as
+        # the cross-process clock-offset handshake: two tracers on the same
+        # host rebase each other's spans via their wall_base difference.
         self._base = time.monotonic()
+        self.wall_base = time.time()
         self._pid = os.getpid()
         self._named: set = set()
         self._dropped = 0
+        #: called with the running drop total each time a span is evicted
+        #: (e.g. to bump verifyd_trace_spans_dropped_total); must be cheap
+        #: and must not call back into the tracer.
+        self.drop_hook: Optional[Callable[[int], None]] = None
+        #: called with every completed "X" event dict (e.g. the flight
+        #: recorder); invoked outside the ring lock.
+        self.span_hook: Optional[Callable[[Dict[str, Any]], None]] = None
 
     @property
     def enabled(self) -> bool:
         return self.capacity > 0
 
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
     def now(self) -> float:
         """A timestamp suitable for add_span (monotonic seconds)."""
         return time.monotonic()
+
+    def us(self, mono: float) -> float:
+        """Convert a ``time.monotonic()`` instant to this tracer's
+        trace-relative microseconds (the ``ts`` unit of its spans)."""
+        return (mono - self._base) * 1e6
+
+    def mono_of_wall(self, wall: float) -> float:
+        """Map a wall-clock instant onto this tracer's monotonic timeline.
+
+        Used to place events that only exist as wall time — e.g. the
+        client's ``sent_wall`` from the submit frame — onto the daemon's
+        span timeline.  Subject to wall-clock skew; callers clamp.
+        """
+        return self._base + (wall - self.wall_base)
 
     def __len__(self) -> int:
         with self._lock:
@@ -82,10 +114,22 @@ class Tracer:
         }
         if args:
             ev["args"] = args
+        dropped = None
         with self._lock:
             if len(self._ring) == self._ring.maxlen:
                 self._dropped += 1
+                dropped = self._dropped
             self._ring.append(ev)
+        if dropped is not None and self.drop_hook is not None:
+            try:
+                self.drop_hook(dropped)
+            except Exception:
+                pass
+        if self.span_hook is not None:
+            try:
+                self.span_hook(ev)
+            except Exception:
+                pass
 
     @contextmanager
     def span(
@@ -127,19 +171,66 @@ class Tracer:
                 }
             )
 
+    def merge_child(
+        self,
+        spans: Sequence[Dict[str, Any]],
+        *,
+        child_wall_base: float,
+        tid: int,
+        clamp: Optional[Tuple[float, float]] = None,
+        extra_args: Optional[Dict[str, Any]] = None,
+    ) -> int:
+        """Stitch a child process's span ring onto this tracer's timeline.
+
+        ``child_wall_base`` is the child tracer's ``wall_base`` (shipped
+        back in the result JSON) — the clock-offset handshake.  ``clamp``
+        is the parent's observed [t0, t1] window for the child in
+        ``time.monotonic()`` seconds; rebased spans are pinned inside it
+        so clock skew can never produce negative durations or child spans
+        outside the escalation that ran them.  Returns how many spans
+        were merged.
+        """
+        if not self.enabled or not spans:
+            return 0
+        offset_us = (child_wall_base - self.wall_base) * 1e6
+        clamp_us = None
+        if clamp is not None:
+            clamp_us = (self.us(clamp[0]), self.us(clamp[1]))
+        merged = rebase_spans(
+            spans,
+            offset_us=offset_us,
+            tid=tid,
+            pid=self._pid,
+            clamp_us=clamp_us,
+            extra_args=extra_args,
+        )
+        with self._lock:
+            for ev in merged:
+                if len(self._ring) == self._ring.maxlen:
+                    self._dropped += 1
+                self._ring.append(ev)
+        return len(merged)
+
     def export(self) -> Dict[str, Any]:
         """Snapshot the ring as a loadable trace_event JSON object."""
         with self._lock:
             events: List[Dict[str, Any]] = list(self._ring)
             dropped = self._dropped
+        other: Dict[str, Any] = {
+            "producer": "s2-verification-tpu",
+            "span_capacity": self.capacity,
+            "spans_dropped": dropped,
+            "wall_base": round(self.wall_base, 6),
+        }
+        if dropped:
+            other["warning"] = (
+                "span ring saturated: %d span(s) dropped; timeline is "
+                "truncated — raise --trace-capacity" % dropped
+            )
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {
-                "producer": "s2-verification-tpu",
-                "span_capacity": self.capacity,
-                "spans_dropped": dropped,
-            },
+            "otherData": other,
         }
 
 

@@ -37,6 +37,7 @@ import random
 import socket
 import time
 
+from ..obs.context import TRACE_FIELD, new_trace_id, trace_frame
 from .protocol import (
     ERR_AUTH,
     ERR_FRAME,
@@ -224,16 +225,28 @@ class VerifydClient:
         priority: int = 10,
         no_viz: bool | None = None,
         timeout: float | None = None,
+        trace_id: str | None = None,
     ) -> dict:
+        """Submit one history.  Mints a distributed ``trace_id`` (unless
+        the caller supplies one, e.g. across a retry loop) and sends it in
+        the optional ``trace`` frame field — old daemons ignore it; new
+        daemons thread it through every span and echo it back.  The reply
+        always carries ``trace_id`` (filled in client-side against an old
+        daemon), so callers can correlate unconditionally."""
+        tid = trace_id or new_trace_id()
         req: dict = {
             "op": "submit",
             "history": history_text,
             "client": client,
             "priority": priority,
+            TRACE_FIELD: trace_frame(tid),
         }
         if no_viz is not None:
             req["no_viz"] = no_viz
-        return self._call(req, timeout=timeout)
+        reply = self._call(req, timeout=timeout)
+        if isinstance(reply, dict):
+            reply.setdefault("trace_id", tid)
+        return reply
 
     def submit_with_retry(
         self,
@@ -259,6 +272,8 @@ class VerifydClient:
         mapping (75 busy / 69 unavailable / 76 refused).
         """
         rng = rng or random.Random()
+        # One logical request = one trace id, however many wire attempts.
+        kw.setdefault("trace_id", new_trace_id())
         for attempt in range(retries + 1):
             try:
                 return self.submit(history_text, **kw)

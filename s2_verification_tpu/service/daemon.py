@@ -36,7 +36,11 @@ from dataclasses import dataclass, field
 
 from .. import version as _version
 from ..checker.entries import prepare
+from ..obs.context import TRACE_FIELD, new_trace_id, parse_trace_frame
+from ..obs.flight import FLIGHT_SUBDIR, FlightRecorder
+from ..obs.health import SLOConfig, SLOHealth
 from ..obs.httpd import MetricsServer
+from ..obs.log import StructuredLogger
 from ..obs.metrics import MetricsRegistry
 from ..obs.trace import Tracer
 from ..utils import events as ev
@@ -118,6 +122,14 @@ class VerifydConfig:
     mesh_devices: int | None = None
     #: how long an escalation waits for a lease before running unsharded
     lease_timeout_s: float = 120.0
+    #: structured-log line format for daemon diagnostics (and the
+    #: stats_log="-" fallback): "text" or "json"
+    log_format: str = "text"
+    #: SLO availability target driving /healthz and the burn-rate breach
+    #: events; 1.0 disables burn math (never degraded by errors)
+    slo_target: float = 0.99
+    #: end-to-end latency target (p95 on the short window) for /healthz
+    slo_latency_target_s: float = 5.0
     extra: dict = field(default_factory=dict)
 
 
@@ -131,17 +143,49 @@ class Verifyd:
             raise ValueError(
                 "a TCP listener requires a shared secret (VerifydConfig.secret)"
             )
+        self.logger = StructuredLogger(
+            sys.stderr, fmt=config.log_format, component="verifyd"
+        )
         self._stats_file = None
         sink = None
+        stats_logger = None
         if config.stats_log == "-":
-            sink = sys.stderr
+            # The old ad-hoc raw-stderr sink: events now flow through the
+            # structured logger so they share format + stream with every
+            # other daemon diagnostic.
+            stats_logger = self.logger
         elif config.stats_log:
             self._stats_file = open(config.stats_log, "a", encoding="utf-8")
             sink = self._stats_file
         self.registry = MetricsRegistry()
         self.tracer = Tracer(config.trace_capacity)
         self.tracer.name_track(0, "admission")
-        self.stats = ServiceStats(sink, registry=self.registry)
+        self._m_trace_dropped = self.registry.counter(
+            "verifyd_trace_spans_dropped_total",
+            "Spans evicted from the saturated trace ring (timelines truncated)",
+        )
+        self._m_trace_dropped.inc(0)
+        self.tracer.drop_hook = lambda _total: self._m_trace_dropped.inc()
+        self.health = SLOHealth(
+            SLOConfig(
+                availability_target=config.slo_target,
+                latency_target_s=config.slo_latency_target_s,
+            ),
+            registry=self.registry,
+        )
+        self.flight = None
+        if config.state_dir:
+            self.flight = FlightRecorder(
+                os.path.join(config.state_dir, FLIGHT_SUBDIR), fsync=config.fsync
+            )
+            self.tracer.span_hook = self.flight.record_span
+        self.stats = ServiceStats(
+            sink,
+            registry=self.registry,
+            health=self.health,
+            recorder=self.flight,
+            logger=stats_logger,
+        )
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
         )
@@ -209,7 +253,7 @@ class Verifyd:
     def __enter__(self) -> "Verifyd":
         if self.cfg.metrics_port is not None:
             self._metrics_server = MetricsServer(
-                self.registry, self.cfg.metrics_port
+                self.registry, self.cfg.metrics_port, health=self.health
             )
             self.metrics_port = self._metrics_server.port
         self._recover_orphans()
@@ -245,6 +289,9 @@ class Verifyd:
         if self._metrics_server is not None:
             self._metrics_server.close()
         self.stats.emit("serve_stop", **self.stats.snapshot())
+        self.dump_flight("shutdown")
+        if self.flight is not None:
+            self.flight.close()
         self.cache.close()
         if self.journal is not None:
             self.journal.close()
@@ -283,6 +330,7 @@ class Verifyd:
                 events=events,
                 hist=hist,
                 no_viz=True,  # the submitter is gone; re-run for the verdict
+                trace_id=new_trace_id(),
             )
             self.journal.accept(
                 job=job.id,
@@ -309,6 +357,13 @@ class Verifyd:
                 from_boot=rec.get("boot"),
             )
         self.journal.compact()
+
+    def dump_flight(self, reason: str) -> None:
+        """Write a flight-recorder marker with the SLO picture at this
+        instant (shutdown path, SIGTERM handler).  Safe without a state
+        dir (no-op) and safe to call more than once."""
+        if self.flight is not None:
+            self.flight.dump(reason, slo=self.health.snapshot())
 
     def request_stop(self) -> None:
         """Thread-safe stop trigger (shutdown op, signal handler)."""
@@ -501,6 +556,11 @@ class Verifyd:
 
     async def _submit(self, req: dict) -> dict:
         t_recv = self.tracer.now()
+        # Distributed trace context: honor a client-minted id (new
+        # clients), mint one otherwise (old clients) — every job traces.
+        trace_id, sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
         text = req.get("history")
         if not isinstance(text, str) or not text.strip():
             self.stats.emit("decode_error", reason="missing history")
@@ -536,9 +596,9 @@ class Verifyd:
                 t_recv,
                 self.tracer.now(),
                 tid=0,
-                args={"client": client, "cached": True},
+                args={"client": client, "cached": True, "trace_id": trace_id},
             )
-            cached.update(cached=True, queue_wait_s=0.0)
+            cached.update(cached=True, queue_wait_s=0.0, trace_id=trace_id)
             return ok(cached)
 
         job = Job(
@@ -550,6 +610,7 @@ class Verifyd:
             events=events,
             hist=hist,
             no_viz=no_viz,
+            trace_id=trace_id,
         )
         fut: asyncio.Future = self._loop.create_future()
 
@@ -603,16 +664,39 @@ class Verifyd:
             priority=priority,
             shape=job.shape,
             depth=depth,
+            trace_id=trace_id,
         )
         self.stats.set_queue_depth(depth)
         if self.tracer.enabled:
             self.tracer.name_track(job.id, f"job {job.id} ({client})")
-            self.tracer.add_span("prepare", t_prep0, t_prep1, tid=job.id)
+            if sent_wall is not None:
+                # Client-origin span: network + connect + queueing before
+                # the daemon saw the frame.  sent_wall is the client's
+                # wall clock, mapped onto our monotonic timeline and
+                # clamped to t_recv so skew can't produce negative wait.
+                t_sent = min(t_recv, self.tracer.mono_of_wall(sent_wall))
+                self.tracer.add_span(
+                    "client_wait",
+                    t_sent,
+                    t_recv,
+                    tid=job.id,
+                    cat="client",
+                    args={"trace_id": trace_id, "origin": "client"},
+                )
+            self.tracer.add_span(
+                "prepare", t_prep0, t_prep1, tid=job.id,
+                args={"trace_id": trace_id},
+            )
             self.tracer.add_span(
                 "admit",
                 t_recv,
                 job.enqueued_at,
                 tid=job.id,
-                args={"client": client, "shape": job.shape, "depth": depth},
+                args={
+                    "client": client,
+                    "shape": job.shape,
+                    "depth": depth,
+                    "trace_id": trace_id,
+                },
             )
         return await fut

@@ -16,10 +16,18 @@ Ops:
 ``stats``     → ``{"ok": {<counter snapshot>}}``
 ``submit``    → history JSONL text in ``history``; optional ``client``
                 (string identity), ``priority`` (int, lower = sooner),
-                ``no_viz``.  Reply carries the ``check`` verdict
-                (``verdict`` = the CLI exit code 0/1/2, ``outcome``), the
-                HTML artifact path, the backend that decided, queue wait,
-                and ``cached`` (answered from the verdict cache).
+                ``no_viz``, and ``trace`` — a distributed-trace context
+                ``{"trace_id": <32 hex>, "sent_wall": <epoch s>}``
+                (obs/context.py).  The field is *optional and ignored by
+                old daemons* (unknown keys pass through untouched, and
+                the HMAC covers whatever keys are present), so new
+                clients interoperate with old daemons and vice versa; a
+                daemon that understands it threads the id through every
+                span and echoes it as ``trace_id`` in the reply.  Reply
+                carries the ``check`` verdict (``verdict`` = the CLI
+                exit code 0/1/2, ``outcome``), the HTML artifact path,
+                the backend that decided, queue wait, and ``cached``
+                (answered from the verdict cache).
 ``trace``     → ``{"ok": {"traceEvents": [...], ...}}`` — the daemon's
                 in-memory span ring in Chrome trace_event JSON (Object
                 Format); loads directly in Perfetto / chrome://tracing.

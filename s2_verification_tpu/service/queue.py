@@ -48,6 +48,10 @@ class Job:
     events: list  # decoded LabeledEvents (for viz / spooling)
     hist: Any  # prepared History (elide_trivial=True)
     no_viz: bool = False
+    #: distributed trace id (obs/context.py): client-minted when the
+    #: submit frame carried one, daemon-minted otherwise; "" only for
+    #: direct Job construction in tests
+    trace_id: str = ""
     submitted_at: float = field(default_factory=time.monotonic)
     #: monotonic instant the job entered the admission queue (0.0 =
     #: unknown; queue-wait accounting falls back to ``submitted_at``)

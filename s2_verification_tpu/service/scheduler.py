@@ -179,7 +179,12 @@ class Scheduler:
                     self._mark_done(job, verdict=None, outcome="error")
                     # Balance the `start` event so in-flight accounting
                     # (active-jobs gauge, retry-after hint) can't leak.
-                    self.stats.emit("job_error", job=job.id, reason=repr(e)[:200])
+                    self.stats.emit(
+                        "job_error",
+                        job=job.id,
+                        reason=repr(e)[:200],
+                        trace_id=job.trace_id,
+                    )
                 job.resolve(reply)
 
     def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
@@ -202,13 +207,19 @@ class Scheduler:
         # from the verdict cache at execution time too.
         cached = self.cache.get(job.fingerprint)
         if cached is not None:
-            cached.update(cached=True, job=job.id, queue_wait_s=round(queue_wait, 4))
+            cached.update(
+                cached=True,
+                job=job.id,
+                queue_wait_s=round(queue_wait, 4),
+                trace_id=job.trace_id,
+            )
             self.stats.emit(
                 "cache_hit",
                 stage="execute",
                 job=job.id,
                 client=job.client,
                 queue_wait_s=round(queue_wait, 4),
+                trace_id=job.trace_id,
             )
             self._mark_done(
                 job,
@@ -225,9 +236,16 @@ class Scheduler:
             shape=job.shape,
             shape_warm=warm,
             queue_wait_s=round(queue_wait, 4),
+            trace_id=job.trace_id,
         )
         if job.enqueued_at:
-            self.tracer.add_span("queue_wait", job.enqueued_at, t_pick, tid=job.id)
+            self.tracer.add_span(
+                "queue_wait",
+                job.enqueued_at,
+                t_pick,
+                tid=job.id,
+                args={"trace_id": job.trace_id},
+            )
         t0 = time.monotonic()
         res, backend = self._portfolio(job)
         wall = time.monotonic() - t0
@@ -236,7 +254,11 @@ class Scheduler:
             t0,
             t0 + wall,
             tid=job.id,
-            args={"backend": backend, "outcome": res.outcome.value},
+            args={
+                "backend": backend,
+                "outcome": res.outcome.value,
+                "trace_id": job.trace_id,
+            },
         )
 
         artifact = None
@@ -257,6 +279,7 @@ class Scheduler:
             "shape_warm": warm,
             "artifact": artifact,
             "cached": False,
+            "trace_id": job.trace_id,
         }
         profile = job_profile(res) if self.profile else None
         if profile is not None:
@@ -279,6 +302,7 @@ class Scheduler:
             queue_wait_s=round(queue_wait, 4),
             shape=job.shape,
             shape_warm=warm,
+            trace_id=job.trace_id,
         )
         if profile is not None:
             done_fields["profile"] = profile
@@ -314,9 +338,14 @@ class Scheduler:
                 t_dev,
                 t_end,
                 tid=job.id,
-                args={"degraded": dres is None, "backend": dev_backend},
+                args={
+                    "degraded": dres is None,
+                    "backend": dev_backend,
+                    "trace_id": job.trace_id,
+                },
             )
             self._trace_shards(job, dres, t_dev, t_end)
+            self._merge_child_trace(job, dres, t_dev, t_end)
             if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
                 return dres, dev_backend
             if dres is None:
@@ -369,6 +398,45 @@ class Scheduler:
                     "skew": s.get("skew"),
                 },
             )
+
+    def _merge_child_trace(self, job: Job, res, t0: float, t1: float) -> None:
+        """Stitch a supervised child's span ring onto the job's track.
+
+        The child ships ``{"wall_base", "spans", "dropped", ...}`` back in
+        the result JSON (supervise attaches it as ``res.child_trace``);
+        the parent rebases via the wall_base clock-offset handshake and
+        clamps into the observed escalation window [t0, t1], so the
+        merged timeline can't contain negative durations whatever the
+        clocks did.
+        """
+        child = getattr(res, "child_trace", None)
+        if not isinstance(child, dict) or not self.tracer.enabled:
+            return
+        spans = child.get("spans") or []
+        try:
+            wall_base = float(child.get("wall_base", 0.0))
+        except (TypeError, ValueError):
+            return
+        if not spans or wall_base <= 0:
+            return
+        merged = self.tracer.merge_child(
+            spans,
+            child_wall_base=wall_base,
+            tid=job.id,
+            clamp=(t0, t1),
+            extra_args={
+                "origin": "child",
+                "trace_id": job.trace_id,
+                "child_pid": child.get("pid"),
+            },
+        )
+        if child.get("dropped"):
+            log.warning(
+                "job %d: child span ring dropped %s spans (truncated child timeline)",
+                job.id,
+                child.get("dropped"),
+            )
+        log.debug("job %d: merged %d child spans", job.id, merged)
 
     def _escalate_device(self, job: Job) -> tuple[CheckResult | None, str]:
         """Run the device search, leasing a chip set from the pool when one
@@ -429,6 +497,7 @@ class Scheduler:
                     device_rows=self.device_rows,
                     devices=lease.indices if lease is not None else None,
                     profile=self.profile,
+                    trace_id=job.trace_id,
                     log=lambda s: log.info("job %d supervise: %s", job.id, s),
                     tracer=self.tracer,
                 ),

@@ -16,7 +16,10 @@ events: ``cache_loaded`` (persistent verdict segments replayed at boot),
 ``orphan_dropped`` / ``orphan_invalid`` (reported, not silently lost),
 ``auth_reject`` (TCP frame failed HMAC verification — rejected before
 admission), ``frame_error`` (oversized or malformed frame),
-``stats_sink_lost`` (the event sink broke twice; counters survive).
+``stats_sink_lost`` (the event sink broke twice; counters survive);
+``slo_breach`` (the SLO engine's edge-triggered burn-rate trip — emitted
+back onto this same stream so sinks, the flight recorder, and counters
+all see it).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -33,9 +36,14 @@ from __future__ import annotations
 import json
 import threading
 import time
-from typing import IO, Optional
+from typing import IO, TYPE_CHECKING, Optional
 
 from ..obs.metrics import LATENCY_BUCKETS, LAYER_BUCKETS, MetricsRegistry
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..obs.flight import FlightRecorder
+    from ..obs.health import SLOHealth
+    from ..obs.log import StructuredLogger
 
 __all__ = ["ServiceStats"]
 
@@ -47,8 +55,21 @@ class ServiceStats:
         self,
         sink: IO[str] | None = None,
         registry: Optional[MetricsRegistry] = None,
+        *,
+        health: "Optional[SLOHealth]" = None,
+        recorder: "Optional[FlightRecorder]" = None,
+        logger: "Optional[StructuredLogger]" = None,
     ) -> None:
         self._sink = sink
+        #: SLO engine fed every event (outside the sink lock); its breach
+        #: edge re-enters emit() as an ``slo_breach`` event.
+        self.health = health
+        #: flight recorder absorbing every event line for post-mortems
+        self.recorder = recorder
+        #: structured logger; when set and no sink is configured, events
+        #: flow through it instead of a raw stderr stream
+        self.logger = logger
+        self._in_breach_emit = False
         self._lock = threading.Lock()
         self._t0 = time.time()
         self._counters: dict[str, int] = {
@@ -70,6 +91,7 @@ class ServiceStats:
             "stats_sink_lost": 0,
             "leases_granted": 0,
             "lease_timeouts": 0,
+            "slo_breaches": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -176,12 +198,12 @@ class ServiceStats:
     # -- event stream -------------------------------------------------------
 
     def emit(self, event: str, **fields) -> None:
+        line = {"ev": event, "t": round(time.time(), 3)}
+        line.update(fields)
         with self._lock:
             self._count(event, fields)
             if self._sink is not None:
-                line = {"ev": event, "t": round(time.time(), 3)}
-                line.update(fields)
-                payload = json.dumps(line, separators=(",", ":")) + "\n"
+                payload = json.dumps(line, separators=(",", ":"), default=str) + "\n"
                 # A broken stats sink must never take a job down — but a
                 # single transient OSError (EINTR, brief ENOSPC) must not
                 # silence the stream forever either: retry once, then drop
@@ -198,7 +220,28 @@ class ServiceStats:
                     except OSError:
                         if attempt:
                             self._drop_sink()
-        # end critical section
+            elif self.logger is not None:
+                self.logger.event(event, fields)
+        # Observability consumers run outside the sink lock: neither the
+        # flight recorder's disk flush nor the SLO window math may extend
+        # the emit critical section every job passes through.
+        if self.recorder is not None:
+            self.recorder.record_event(line)
+        if self.health is not None and not self._in_breach_emit:
+            self.health.observe_event(line)
+            breach = self.health.check_breach()
+            if breach is not None:
+                # Re-entrant emit: slo_breach rides the same stream as
+                # everything else (sink, recorder, logger, counters).  The
+                # guard only stops a breach from evaluating itself; the
+                # engine also ignores non-outcome events, so no feedback.
+                self._in_breach_emit = True
+                try:
+                    self.emit("slo_breach", **breach)
+                finally:
+                    self._in_breach_emit = False
+                if self.recorder is not None:
+                    self.recorder.dump("slo_breach", breach=breach, slo=self.health.snapshot())
 
     def _drop_sink(self) -> None:
         # Caller holds self._lock.
@@ -242,6 +285,8 @@ class ServiceStats:
         elif event == "lease_timeout":
             self._counters["lease_timeouts"] += 1
             self._m_lease_timeouts.inc()
+        elif event == "slo_breach":
+            self._counters["slo_breaches"] += 1
         elif event == "auth_reject":
             self._counters["auth_rejects"] += 1
             self._m_auth_rejects.inc()
@@ -321,6 +366,8 @@ class ServiceStats:
             done = self._counters["completed"]
             snap["avg_wall_s"] = round(self._wall_total_s / done, 4) if done else 0.0
         snap["metrics"] = self.registry.snapshot()
+        if self.health is not None:
+            snap["slo"] = self.health.snapshot()
         return snap
 
     def retry_after_hint(self, queue_depth: int) -> float:

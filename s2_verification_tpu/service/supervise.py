@@ -67,6 +67,12 @@ def _result_from_json(obj: dict) -> CheckResult:
         res.stats = FrontierStats(  # type: ignore[attr-defined]
             **{k: v for k, v in st.items() if k in known}
         )
+    # The child's own span ring (``{"trace_id", "pid", "wall_base",
+    # "spans", "dropped"}``) rides the result JSON home; the scheduler
+    # stitches it onto the job's trace track via clock rebasing.
+    trace = obj.get("trace")
+    if isinstance(trace, dict):
+        res.child_trace = trace  # type: ignore[attr-defined]
     return res
 
 
@@ -80,6 +86,7 @@ def supervised_device_check(
     device_rows: int | None = None,
     devices: tuple[int, ...] | list[int] | None = None,
     profile: bool = False,
+    trace_id: str = "",
     probe: bool | None = None,
     log=None,
     tracer=None,
@@ -132,6 +139,10 @@ def supervised_device_check(
         cmd.append("devices=" + ",".join(str(int(i)) for i in devices))
     if profile:
         cmd.append("profile=1")
+    if trace_id:
+        # Distributed-trace propagation: the child runs its own Tracer
+        # under this id and ships its span ring back in the result JSON.
+        cmd.append("trace=" + trace_id)
     try:
         outcome = drive(
             cmd,
@@ -160,17 +171,24 @@ def supervised_device_check(
 def _child_main(argv: list[str]) -> int:
     hist_path, ckpt_path, out_path = argv[:3]
     # Trailing argv: a bare int is the legacy device_rows cap; `key=value`
-    # extras carry the mesh grant and the profile flag.
+    # extras carry the mesh grant, the profile flag, and the trace id.
     device_rows: int | None = None
     devices: list[int] | None = None
     profile = False
+    trace_id = ""
     for extra in argv[3:]:
         if extra.startswith("devices="):
             devices = [int(s) for s in extra[len("devices=") :].split(",") if s]
         elif extra.startswith("profile="):
             profile = extra[len("profile=") :] == "1"
+        elif extra.startswith("trace="):
+            trace_id = extra[len("trace=") :]
         else:
             device_rows = int(extra)
+    if not trace_id:
+        from ..obs.context import ENV_TRACE
+
+        trace_id = os.environ.get(ENV_TRACE, "")
 
     # Same pin discipline as checker/resilient._PROBE_CODE: the axon
     # sitecustomize hook overrides JAX_PLATFORMS, so re-pin via config API.
@@ -182,9 +200,16 @@ def _child_main(argv: list[str]) -> int:
 
     from ..checker.device import check_device_auto
     from ..checker.entries import prepare
+    from ..obs.trace import Tracer
     from ..utils import events as ev
 
-    hist = prepare(ev.read_history(hist_path))
+    # The child's own span ring: a small Tracer whose wall_base rides the
+    # result JSON back so the parent can rebase these spans onto its
+    # timeline (the clock-offset handshake).  tid is irrelevant here —
+    # the parent re-homes merged spans onto the job's track.
+    tracer = Tracer(512)
+    with tracer.span("child_prepare", cat="child", args={"trace_id": trace_id}):
+        hist = prepare(ev.read_history(hist_path))
     kw: dict = {} if device_rows is None else {"device_rows_cap": device_rows}
     if profile:
         kw["profile"] = True
@@ -204,10 +229,23 @@ def _child_main(argv: list[str]) -> int:
         # families are fed from the result JSON, profile or not.
         kw["mesh"] = frontier_mesh(devices=[ds[i] for i in devices])
         kw["collect_stats"] = True
-    res = check_device_auto(hist, checkpoint_path=ckpt_path, **kw)
+    with tracer.span(
+        "child_search",
+        cat="child",
+        args={"trace_id": trace_id, "devices": devices or []},
+    ):
+        res = check_device_auto(hist, checkpoint_path=ckpt_path, **kw)
+    out = _result_to_json(res)
+    out["trace"] = {
+        "trace_id": trace_id,
+        "pid": os.getpid(),
+        "wall_base": round(tracer.wall_base, 6),
+        "spans": tracer.export()["traceEvents"],
+        "dropped": tracer.dropped,
+    }
     tmp = f"{out_path}.tmp.{os.getpid()}"
     with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(_result_to_json(res), f)
+        json.dump(out, f)
     os.replace(tmp, out_path)
     return 0
 

@@ -14,10 +14,20 @@ What it checks (the `make obs` gate):
    the nested admit→prepare and search→engine span structure, every
    event JSON-serializable and ``ph``-valid — i.e. Perfetto-loadable;
 4. per-job ``profile`` payloads ride the submit replies when the daemon
-   runs with ``profile=True``.
+   runs with ``profile=True``;
+5. the SLO surface: ``verifyd_slo_*`` families in the scrape, ``/healthz``
+   answering 200 with a machine-readable JSON body, ``/slo`` serving the
+   window snapshot;
+6. failure burst → health flip: with the CPU engine stubbed to raise, a
+   burst of erroring jobs must push the burn rate past the fast
+   threshold — ``/healthz`` flips 503 with a reason string and the
+   ``slo_breach`` event/counter fires;
+7. distributed trace stitching: one supervised-escalated job's trace must
+   carry client-, daemon-, AND child-origin spans under a single
+   ``trace_id`` on the job's track, with no negative durations.
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
-Pure stdlib + the package; runs on CPU in a few seconds.
+Pure stdlib + the package; runs on CPU in under a minute.
 """
 
 from __future__ import annotations
@@ -27,6 +37,7 @@ import json
 import os
 import sys
 import tempfile
+import urllib.error
 import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -49,6 +60,15 @@ REQUIRED_SHARD_FAMILIES = (
     "verifyd_leases_granted_total",
     "verifyd_devices_leased",
     "verifyd_lease_wait_seconds",
+)
+
+#: SLO families the health engine must export (PR 5: obs v2)
+REQUIRED_SLO_FAMILIES = (
+    "verifyd_slo_availability",
+    "verifyd_slo_burn_rate",
+    "verifyd_slo_latency_seconds",
+    "verifyd_slo_healthy",
+    "verifyd_slo_breaches_total",
 )
 
 #: virtual CPU devices for the mesh phase (set before first jax use)
@@ -263,6 +283,39 @@ def main() -> int:
             if not ok_nest:
                 return _fail("no admit span contains a prepare span")
 
+            # SLO surface: families, healthz JSON, /slo snapshot.
+            for fam in REQUIRED_SLO_FAMILIES:
+                if fam not in kinds and fam not in body:
+                    # refresh-on-scrape may have landed after the first
+                    # read; one more scrape before declaring it missing
+                    body = (
+                        urllib.request.urlopen(url, timeout=5)
+                        .read()
+                        .decode("utf-8")
+                    )
+                    if fam not in body:
+                        return _fail(f"SLO family {fam} missing from /metrics")
+            hz = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            )
+            if hz.status != 200:
+                return _fail(f"healthy daemon answered /healthz {hz.status}")
+            hz_body = json.loads(hz.read().decode("utf-8"))
+            if hz_body.get("status") != "ok" or hz_body.get("reasons"):
+                return _fail(f"unexpected healthz body: {hz_body}")
+            slo = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo", timeout=5
+                )
+                .read()
+                .decode("utf-8")
+            )
+            if not slo.get("healthy") or "windows" not in slo:
+                return _fail(f"unexpected /slo snapshot: {slo}")
+            snap = client.stats()
+            if "slo" not in snap:
+                return _fail("stats op snapshot lacks the slo section")
+
     # -- mesh phase: per-shard families after a sharded escalation ----------
     from s2_verification_tpu.service import scheduler as sched_mod
     from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
@@ -349,11 +402,154 @@ def main() -> int:
     finally:
         sched_mod._cpu_check = real_cpu_check
 
+    # -- burst phase: failure burst must flip /healthz to 503 ---------------
+    from s2_verification_tpu.service.client import VerifydError
+
+    def _boom(hist, budget, profile=False):
+        raise RuntimeError("obs-check induced engine failure")
+
+    sched_mod._cpu_check = _boom
+    # The 12 induced failures each log a full traceback; that's the
+    # scheduler doing its job, not diagnostic signal for this gate.
+    import logging
+
+    logging.getLogger("s2_verification_tpu").setLevel(logging.CRITICAL)
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-burst-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="off",
+                metrics_port=0,
+            )
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                errors = 0
+                # Enough bad events to clear the engine's min_events
+                # cold-start guard and saturate the 1m error rate.
+                for i in range(12):
+                    try:
+                        client.submit(texts[i % len(texts)], client="burst")
+                    except VerifydError:
+                        errors += 1
+                if errors < 10:
+                    return _fail(
+                        f"induced burst produced only {errors}/12 errors"
+                    )
+                hz_url = f"http://127.0.0.1:{daemon.metrics_port}/healthz"
+                try:
+                    resp = urllib.request.urlopen(hz_url, timeout=5)
+                    return _fail(
+                        f"/healthz stayed {resp.status} through a "
+                        "100% failure burst"
+                    )
+                except urllib.error.HTTPError as e:
+                    if e.code != 503:
+                        return _fail(f"/healthz answered {e.code}, want 503")
+                    hz_body = json.loads(e.read().decode("utf-8"))
+                if hz_body.get("status") == "ok" or not hz_body.get(
+                    "reasons"
+                ):
+                    return _fail(
+                        f"503 healthz body lacks machine-readable "
+                        f"reasons: {hz_body}"
+                    )
+                snap = client.stats()
+                if not snap.get("slo_breaches"):
+                    return _fail(
+                        f"burst never fired slo_breach: "
+                        f"slo_breaches={snap.get('slo_breaches')}"
+                    )
+                body = (
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{daemon.metrics_port}/metrics",
+                        timeout=5,
+                    )
+                    .read()
+                    .decode("utf-8")
+                )
+                breach_lines = [
+                    line
+                    for line in body.splitlines()
+                    if line.startswith("verifyd_slo_breaches_total")
+                    and not line.startswith("#")
+                ]
+                if not breach_lines or all(
+                    float(line.rsplit(" ", 1)[1]) == 0 for line in breach_lines
+                ):
+                    return _fail(
+                        f"verifyd_slo_breaches_total never moved: "
+                        f"{breach_lines}"
+                    )
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+        logging.getLogger("s2_verification_tpu").setLevel(logging.NOTSET)
+
+    # -- stitch phase: one supervised job, three span origins, one id -------
+    sched_mod._cpu_check = lambda hist, budget, profile=False: (
+        CheckResult(CheckOutcome.UNKNOWN),
+        "native",
+    )
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-stitch-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="supervised",
+                time_budget_s=0.01,
+                spool_dir=os.path.join(d, "spool"),
+                metrics_port=0,
+                attempt_timeout_s=120,
+            )
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                reply = client.submit(texts[0], client="stitch", timeout=180)
+                tid = reply.get("trace_id")
+                if not tid:
+                    return _fail(f"submit reply carries no trace_id: {reply}")
+                events = client.trace()["traceEvents"]
+                mine = [
+                    e
+                    for e in events
+                    if e.get("ph") == "X"
+                    and (e.get("args") or {}).get("trace_id") == tid
+                ]
+                origins = {
+                    (e.get("args") or {}).get("origin") or "daemon"
+                    for e in mine
+                }
+                if not {"client", "daemon", "child"} <= origins:
+                    return _fail(
+                        f"stitched trace {tid} spans only origins "
+                        f"{sorted(origins)}: "
+                        f"{sorted(e['name'] for e in mine)}"
+                    )
+                if len({e.get("tid") for e in mine}) != 1:
+                    return _fail(
+                        f"trace {tid} spread over tracks "
+                        f"{sorted({e.get('tid') for e in mine}, key=str)}"
+                    )
+                neg = [e for e in events if e.get("ph") == "X" and e["dur"] < 0]
+                if neg:
+                    return _fail(f"negative span durations after stitch: {neg}")
+                stitched = len(mine)
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
         f"{len(spans)} spans, {len(profiled)} profiled jobs, "
         f"{len(REQUIRED_SHARD_FAMILIES)} shard/lease families over "
-        f"{len(shard_labels)} shards ({backend})"
+        f"{len(shard_labels)} shards ({backend}), "
+        f"{len(REQUIRED_SLO_FAMILIES)} SLO families, healthz flipped 503 "
+        f"after {errors} induced errors, {stitched} spans stitched under "
+        f"one trace id"
     )
     return 0
 

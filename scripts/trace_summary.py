@@ -1,6 +1,15 @@
 """Summarize a ``jax.profiler.trace`` capture: top time sinks + busy/idle.
 
 Usage: python scripts/trace_summary.py TRACE_DIR [--top N] [--json]
+       python scripts/trace_summary.py TRACE.json [--json]   (stitched mode)
+
+Stitched mode: when the argument is a ``.json`` file (a verifyd ``trace``
+export, Chrome trace_event format), the tool groups spans by distributed
+``trace_id`` instead of by device track and answers the cross-process
+question: for each request, where did the wall time go *between*
+processes — client wait vs. daemon queue vs. supervised-child work?  It
+also audits the stitch itself, flagging negative durations and partially
+overlapping same-track spans (both signs of a botched clock rebase).
 
 Reads the Chrome-format ``*.trace.json.gz`` that every capture writes
 (alongside the xplane.pb, which needs profiler protos this image's
@@ -161,6 +170,135 @@ def summarize(session_dir: str, top: int = 15) -> dict:
     return {"session": session_dir, "tracks": tracks}
 
 
+# -- stitched mode (verifyd trace exports) ---------------------------------
+
+#: spans whose durations ARE the cross-process boundaries, in pipeline
+#: order: client-side wait, daemon admission, queue, daemon-side search,
+#: supervised-escalation window, and the child's own phases inside it.
+_BOUNDARIES = (
+    "client_wait",
+    "prepare",
+    "queue_wait",
+    "search",
+    "device",
+    "child_prepare",
+    "child_search",
+)
+
+
+def _origin(e: dict) -> str:
+    return (e.get("args") or {}).get("origin") or "daemon"
+
+
+def _boundary(name: str) -> str | None:
+    if name.startswith("device["):
+        return "device"
+    return name if name in _BOUNDARIES else None
+
+
+def summarize_stitched(trace_path: str) -> dict:
+    with open(trace_path, encoding="utf-8") as f:
+        doc = json.load(f)
+    events = [
+        e
+        for e in doc.get("traceEvents", [])
+        if e.get("ph") == "X" and "dur" in e
+    ]
+    by_trace: dict[str, list[dict]] = collections.defaultdict(list)
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id") or ""
+        by_trace[tid].append(e)
+
+    traces = {}
+    for tid, spans in sorted(by_trace.items()):
+        spans.sort(key=lambda e: float(e.get("ts", 0.0)))
+        t0 = min(float(e["ts"]) for e in spans)
+        t1 = max(float(e["ts"]) + float(e["dur"]) for e in spans)
+        boundaries = []
+        for e in spans:
+            b = _boundary(e.get("name", ""))
+            if b is None:
+                continue
+            boundaries.append(
+                {
+                    "span": e["name"],
+                    "boundary": b,
+                    "origin": _origin(e),
+                    "wall_ms": round(float(e["dur"]) / 1e3, 3),
+                    "clamped": bool((e.get("args") or {}).get("clamped")),
+                }
+            )
+        boundaries.sort(key=lambda b: _BOUNDARIES.index(b["boundary"]))
+
+        # Stitch audit.  Negative durations cannot come out of a correct
+        # rebase (the clamp forbids them); a *partial* overlap between
+        # same-track spans — neither nested nor disjoint — means two
+        # clocks disagree about ordering.  Nesting is normal (span
+        # hierarchy), so only the partial case is flagged.
+        anomalies = []
+        for e in spans:
+            if float(e["dur"]) < 0:
+                anomalies.append(
+                    {"kind": "negative_duration", "span": e["name"],
+                     "dur_us": float(e["dur"])}
+                )
+        by_track: dict = collections.defaultdict(list)
+        for e in spans:
+            by_track[e.get("tid")].append(e)
+        for track_spans in by_track.values():
+            for a, b in zip(track_spans, track_spans[1:]):
+                a_end = float(a["ts"]) + float(a["dur"])
+                b_end = float(b["ts"]) + float(b["dur"])
+                if float(b["ts"]) < a_end and b_end > a_end:
+                    anomalies.append(
+                        {
+                            "kind": "partial_overlap",
+                            "spans": [a["name"], b["name"]],
+                            "overlap_us": round(a_end - float(b["ts"]), 3),
+                        }
+                    )
+        traces[tid or "(untraced)"] = {
+            "spans": len(spans),
+            "origins": dict(
+                collections.Counter(_origin(e) for e in spans)
+            ),
+            "wall_ms": round((t1 - t0) / 1e3, 3),
+            "tracks": sorted(
+                {e.get("tid") for e in spans}, key=str
+            ),
+            "boundaries": boundaries,
+            "anomalies": anomalies,
+        }
+    warning = (doc.get("otherData") or {}).get("warning")
+    return {"trace": trace_path, "traces": traces, "warning": warning}
+
+
+def render_stitched(summary: dict) -> str:
+    out = [f"# stitched trace summary: {summary['trace']}"]
+    if summary.get("warning"):
+        out.append(f"!! {summary['warning']}")
+    for tid, t in summary["traces"].items():
+        origins = ", ".join(
+            f"{k}:{v}" for k, v in sorted(t["origins"].items())
+        )
+        out.append(
+            f"\n## trace {tid}: {t['spans']} spans ({origins}), "
+            f"wall {t['wall_ms']:.1f} ms, tracks {t['tracks']}"
+        )
+        for b in t["boundaries"]:
+            mark = "  (clamped)" if b["clamped"] else ""
+            out.append(
+                f"   {b['wall_ms']:10.2f} ms  [{b['origin']:<6s}] "
+                f"{b['span']}{mark}"
+            )
+        for a in t["anomalies"]:
+            out.append(f"   !! {json.dumps(a, sort_keys=True)}")
+        if not t["anomalies"]:
+            out.append("   stitch ok: no negative or partially "
+                       "overlapping spans")
+    return "\n".join(out)
+
+
 def render(summary: dict) -> str:
     out = [f"# trace summary: {summary['session']}"]
     # Device tracks first (TPU/accelerator), host threads after.
@@ -186,10 +324,16 @@ def render(summary: dict) -> str:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("trace_dir")
+    ap.add_argument("trace_dir", help="profiler dir, or a .json verifyd "
+                    "trace export (stitched mode)")
     ap.add_argument("--top", type=int, default=15)
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.trace_dir.endswith(".json") and os.path.isfile(args.trace_dir):
+        s = summarize_stitched(args.trace_dir)
+        print(json.dumps(s) if args.json else render_stitched(s))
+        return 0
 
     session = latest_capture(args.trace_dir)
     if session is None:

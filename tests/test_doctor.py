@@ -1,0 +1,235 @@
+"""Flight recorder + doctor tests: the bounded on-disk ring, synthetic
+post-mortems, clean-shutdown detection on a real daemon, and the headline
+scenario — a SIGKILLed daemon whose state dir the doctor reads cold
+(flight tail including the SLO breach, plus the orphaned journal entry).
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from helpers import H, fold
+from s2_verification_tpu import cli
+from s2_verification_tpu.obs.flight import (
+    FLIGHT_SUBDIR,
+    FlightRecorder,
+    postmortem,
+    read_flight,
+    render_postmortem,
+)
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.utils import events as ev
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flight_dir(state_dir):
+    return os.path.join(str(state_dir), FLIGHT_SUBDIR)
+
+
+def _good() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_replayable(tmp_path):
+    rec = FlightRecorder(
+        _flight_dir(tmp_path), max_segment_bytes=512, max_segments=2
+    )
+    for i in range(500):
+        rec.record_event({"ev": "done", "t": 1000.0 + i, "job": i})
+    rec.dump("shutdown")
+    rec.close()
+    records = read_flight(str(tmp_path))
+    # Drop-oldest: the ring kept a bounded tail that ends with the dump.
+    assert 0 < len(records) < 500
+    assert records[-1] == {
+        "k": "dump",
+        "t": records[-1]["t"],
+        "reason": "shutdown",
+    }
+    jobs = [r["job"] for r in records if r["k"] == "ev"]
+    assert jobs == sorted(jobs) and jobs[-1] == 499  # newest survives
+
+
+def test_recorder_ignores_non_x_spans_and_survives_close(tmp_path):
+    rec = FlightRecorder(_flight_dir(tmp_path))
+    rec.record_span({"ph": "M", "name": "thread_name"})  # metadata: skipped
+    rec.record_span({"ph": "X", "name": "s", "ts": 1.0, "dur": 2.0, "tid": 3})
+    rec.close()
+    rec.record_event({"ev": "late"})  # after close: silently dropped
+    records = read_flight(str(tmp_path))
+    assert [r["k"] for r in records] == ["span"]
+    assert records[0]["name"] == "s"
+
+
+def test_read_flight_tolerates_missing_ring(tmp_path):
+    assert read_flight(str(tmp_path / "never-existed")) == []
+
+
+# -- synthetic post-mortem ---------------------------------------------------
+
+
+def test_postmortem_reconstructs_breach_leases_and_unclean_death(tmp_path):
+    rec = FlightRecorder(_flight_dir(tmp_path))
+    # Timestamps must be wall-adjacent: dump/span records stamp real wall
+    # time, and the replay evaluates windows at the LAST recorded instant.
+    t = time.time() - 30.0
+    rec.record_event({"ev": "lease_grant", "t": t, "job": 5, "devices": [0, 1]})
+    rec.record_event({"ev": "lease_grant", "t": t + 1, "job": 6, "devices": [2]})
+    rec.record_event({"ev": "lease_release", "t": t + 2, "job": 5})
+    for i in range(12):
+        rec.record_event({"ev": "job_error", "t": t + 3 + i, "job": 10 + i})
+    rec.record_span(
+        {"ph": "X", "name": "search", "ts": 0.0, "dur": 9e6, "tid": 5}
+    )
+    rec.dump(
+        "slo_breach",
+        breach={"reasons": [{"kind": "fast_burn", "burn_rate": 100.0,
+                             "window": "1m"}]},
+    )
+    # No shutdown dump: the daemon died mid-flight.
+    rec.close()
+
+    pm = postmortem(str(tmp_path))
+    assert not pm["clean_shutdown"]
+    assert len(pm["breaches"]) == 1
+    # job 6's grant was never released → open at death.
+    assert [l["job"] for l in pm["open_leases"]] == [6]
+    assert pm["slowest_spans"][0]["name"] == "search"
+    # SLO replayed from the recorded events at the moment of death.
+    assert not pm["slo_at_death"]["healthy"]
+
+    report = render_postmortem(pm)
+    assert "UNCLEAN DEATH" in report
+    assert "SLO breaches recorded" in report
+    assert "fast_burn" in report
+    assert "leases open at death: 1" in report
+    assert "flight tail" in report
+
+
+def test_postmortem_on_clean_daemon_shutdown(tmp_path):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        state_dir=str(tmp_path / "state"),
+    )
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path)
+        assert client.submit(_good(), client="doc")["verdict"] == 0
+    pm = postmortem(cfg.state_dir)
+    assert pm["clean_shutdown"]
+    assert pm["last_record"]["reason"] == "shutdown"
+    # The shutdown dump carries the SLO snapshot at that instant.
+    assert "slo" in pm["last_record"]
+    assert pm["events"] > 0 and pm["spans"] > 0
+    assert "clean shutdown" in render_postmortem(pm)
+
+
+# -- the headline: doctor on a SIGKILLed daemon ------------------------------
+
+_CRASH_DRIVER = """
+import os, sys, threading, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from s2_verification_tpu.service import scheduler as sched_mod
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+
+state_dir, sock, hist_path = sys.argv[1], sys.argv[2], sys.argv[3]
+hist = open(hist_path, encoding="utf-8").read()
+
+calls = {{"n": 0}}
+def stub(h, budget, profile=False):
+    calls["n"] += 1
+    if calls["n"] <= 12:
+        raise RuntimeError("induced failure %d" % calls["n"])
+    time.sleep(600)  # the 13th job hangs: accepted, never closed
+sched_mod._cpu_check = stub
+
+import logging; logging.disable(logging.CRITICAL)
+cfg = VerifydConfig(socket_path=sock, state_dir=state_dir, device="off",
+                    no_viz=True, stats_log=None, workers=1,
+                    out_dir=os.path.join(state_dir, "viz"))
+daemon = Verifyd(cfg).__enter__()
+client = VerifydClient(sock, timeout=120)
+for i in range(12):
+    try:
+        client.submit(hist, client="burst%d" % i)
+    except VerifydError:
+        pass
+threading.Thread(
+    target=lambda: client.submit(hist, client="hung"), daemon=True
+).start()
+while calls["n"] < 13:
+    time.sleep(0.05)
+print("READY", flush=True)
+time.sleep(600)  # parent SIGKILLs us here
+"""
+
+
+def test_doctor_reads_a_sigkilled_daemons_state_dir(tmp_path, capsys):
+    state_dir = str(tmp_path / "state")
+    sock = str(tmp_path / "v.sock")
+    hist_path = str(tmp_path / "hist.jsonl")
+    with open(hist_path, "w", encoding="utf-8") as f:
+        f.write(_good())
+    driver = str(tmp_path / "driver.py")
+    with open(driver, "w", encoding="utf-8") as f:
+        f.write(_CRASH_DRIVER.format(repo=REPO))
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, driver, state_dir, sock, hist_path],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.strip() == "READY", f"driver died early: {line!r}"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    rc = cli.main(["doctor", "--state-dir", state_dir])
+    out = capsys.readouterr().out
+    assert rc == 1  # unclean death is the scriptable verdict
+    assert "UNCLEAN DEATH" in out
+    assert "SLO breaches recorded" in out  # the burst tripped fast burn
+    assert "orphaned journal entries" in out  # the hung 13th job
+    assert "client=hung" in out
+    assert "flight tail" in out
+
+    # The JSON surface agrees with the rendered one.
+    pm = postmortem(state_dir)
+    assert not pm["clean_shutdown"]
+    assert pm["breaches"]
+    assert any(o.get("client") == "hung" for o in pm["orphans"])
+    assert not pm["slo_at_death"]["healthy"]
+
+
+def test_doctor_on_missing_state_dir_is_a_usage_error(tmp_path):
+    rc = cli.main(["doctor", "--state-dir", str(tmp_path / "nope")])
+    assert rc == 64  # EX_USAGE

@@ -1,0 +1,475 @@
+"""Obs v2 tests: distributed trace context + clock rebasing, the SLO
+health engine's window math and breach edge-triggering, the structured
+logger, protocol trace-field compatibility in both directions, and the
+/healthz // /slo HTTP surface.
+
+Runs under the session-wide ``JAX_PLATFORMS=cpu`` pin (conftest.py);
+everything here is in-process and fast — the cross-process stitch is
+exercised end to end by ``scripts/obs_check.py`` (`make obs`).
+"""
+
+import io
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.obs import (
+    MetricsRegistry,
+    SLOConfig,
+    SLOHealth,
+    StructuredLogger,
+    Tracer,
+    new_trace_id,
+    valid_trace_id,
+)
+from s2_verification_tpu.obs.context import (
+    TRACE_FIELD,
+    parse_trace_frame,
+    rebase_spans,
+    trace_frame,
+)
+from s2_verification_tpu.obs.httpd import MetricsServer
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.stats import ServiceStats
+from s2_verification_tpu.utils import events as ev
+
+# -- trace context -----------------------------------------------------------
+
+
+def test_trace_ids_are_w3c_shaped_and_unique():
+    a, b = new_trace_id(), new_trace_id()
+    assert a != b
+    for tid in (a, b):
+        assert valid_trace_id(tid)
+        assert len(tid) == 32
+    assert not valid_trace_id("0" * 32)  # the W3C invalid value
+    assert not valid_trace_id("Z" * 32)
+    assert not valid_trace_id(None)
+    assert not valid_trace_id(123)
+
+
+def test_trace_frame_round_trips_and_malformed_is_absent():
+    tid = new_trace_id()
+    frame = trace_frame(tid)
+    got_tid, got_wall = parse_trace_frame(frame)
+    assert got_tid == tid
+    assert isinstance(got_wall, float)
+    # Malformed context is metadata, never an error: all come back None.
+    assert parse_trace_frame(None) == (None, None)
+    assert parse_trace_frame("nope") == (None, None)
+    assert parse_trace_frame({"trace_id": "short"}) == (None, None)
+    assert parse_trace_frame({"trace_id": tid, "sent_wall": "x"}) == (tid, None)
+
+
+def test_rebase_shifts_clamps_and_never_goes_negative():
+    spans = [
+        {"name": "inside", "ph": "X", "ts": 100.0, "dur": 50.0},
+        {"name": "drifted", "ph": "X", "ts": 900.0, "dur": 500.0},
+        {"name": "meta", "ph": "M", "ts": 0},  # dropped: parent names tracks
+    ]
+    out = rebase_spans(
+        spans,
+        offset_us=1000.0,
+        tid=7,
+        pid=42,
+        clamp_us=(1000.0, 2000.0),
+        extra_args={"origin": "child"},
+    )
+    assert [e["name"] for e in out] == ["inside", "drifted"]
+    inside, drifted = out
+    # In-window span: shifted verbatim, not tagged.
+    assert inside["ts"] == 1100.0 and inside["dur"] == 50.0
+    assert "clamped" not in inside["args"]
+    # Drifted span: pinned to the window boundary, tagged, non-negative.
+    assert drifted["ts"] + drifted["dur"] <= 2000.0
+    assert drifted["dur"] >= 0
+    assert drifted["args"]["clamped"] is True
+    for e in out:
+        assert e["tid"] == 7 and e["pid"] == 42
+        assert e["args"]["origin"] == "child"
+
+
+def test_merge_child_rebases_onto_parent_clock():
+    """The clock-offset handshake round-trip: a child tracer born later
+    than the parent merges back at the right place on the parent's
+    timeline, and a hostile wall_base (clock skew) cannot produce
+    negative durations thanks to the clamp."""
+    parent = Tracer()
+    t0 = parent.now()
+    child = Tracer()  # later birth → positive wall_base offset
+    c0 = child.now()
+    child.add_span("child_work", c0, c0 + 0.010)
+    t1 = parent.now() + 0.050
+
+    n = parent.merge_child(
+        child.export()["traceEvents"],
+        child_wall_base=child.wall_base,
+        tid=9,
+        clamp=(t0, t1),
+        extra_args={"origin": "child"},
+    )
+    assert n == 1
+    merged = [
+        e
+        for e in parent.export()["traceEvents"]
+        if e["name"] == "child_work"
+    ]
+    assert len(merged) == 1
+    e = merged[0]
+    assert e["tid"] == 9
+    assert e["dur"] >= 0
+    # Inside the parent's observed window, on the parent's clock.
+    assert parent.us(t0) - 1 <= e["ts"]
+    assert e["ts"] + e["dur"] <= parent.us(t1) + 1
+
+    # Hostile skew: a wall_base hours in the future still cannot push a
+    # span outside the window or below zero duration.
+    skewed = Tracer()
+    s0 = skewed.now()
+    skewed.add_span("skewed", s0, s0 + 0.010)
+    parent.merge_child(
+        skewed.export()["traceEvents"],
+        child_wall_base=skewed.wall_base + 3600.0,
+        tid=9,
+        clamp=(t0, t1),
+    )
+    got = [e for e in parent.export()["traceEvents"] if e["name"] == "skewed"]
+    assert got[0]["dur"] >= 0
+    assert got[0]["ts"] + got[0]["dur"] <= parent.us(t1) + 1
+    assert got[0]["args"]["clamped"] is True
+
+
+def test_drop_hook_fires_and_export_carries_warning():
+    t = Tracer(capacity=2)
+    seen = []
+    t.drop_hook = seen.append
+    for i in range(5):
+        n = t.now()
+        t.add_span(f"s{i}", n, n)
+    assert seen == [1, 2, 3]  # running drop total, one call per eviction
+    out = t.export()
+    assert out["otherData"]["spans_dropped"] == 3
+    assert "saturated" in out["otherData"]["warning"]
+    assert "wall_base" in out["otherData"]
+
+
+def test_span_hook_sees_every_completed_span():
+    t = Tracer()
+    seen = []
+    t.span_hook = seen.append
+    with t.span("a", tid=1):
+        pass
+    assert [e["name"] for e in seen] == ["a"]
+    t.span_hook = lambda ev: 1 / 0  # a broken hook must not break tracing
+    with t.span("b", tid=1):
+        pass
+    assert len(t) == 2
+
+
+# -- SLO health engine -------------------------------------------------------
+
+
+def _event(name, t, wall_s=0.1, **kw):
+    return {"ev": name, "t": t, "wall_s": wall_s, "queue_wait_s": 0.0, **kw}
+
+
+def test_slo_window_math_with_injected_clock():
+    now = [10_000.0]
+    h = SLOHealth(time_fn=lambda: now[0])
+    # 20 good in the last minute; 10 bad 3 minutes ago (outside 1m,
+    # inside 5m and 30m).
+    for i in range(20):
+        h.observe_event(_event("done", 10_000 - 30 + i, wall_s=0.2))
+    for i in range(10):
+        h.observe_event(_event("job_error", 10_000 - 180 + i))
+    snap = h.snapshot()
+    w1, w5 = snap["windows"]["1m"], snap["windows"]["5m"]
+    assert w1["good"] == 20 and w1["bad"] == 0
+    assert w1["availability"] == 1.0 and w1["burn_rate"] == 0.0
+    assert w5["good"] == 20 and w5["bad"] == 10
+    assert w5["availability"] == pytest.approx(20 / 30, abs=1e-6)
+    # burn = error_rate / (1 - target) = (1/3) / 0.01
+    assert w5["burn_rate"] == pytest.approx((10 / 30) / 0.01, abs=0.01)
+    # Latency quantiles come from the fixed buckets; all goods took 0.2s,
+    # so p95 lands in the bucket containing 0.2.
+    assert 0.0 < w1["latency"]["p95"] <= 1.0
+    # Fast burn (1m) is clean, but the 3-minute-old errors still burn the
+    # 30m window at 33× — the slow-burn alert is exactly what catches a
+    # burst that has aged out of the short window.
+    assert not snap["healthy"]
+    assert [r["kind"] for r in snap["reasons"]] == ["slow_burn"]
+    assert snap["windows"]["30m"]["burn_rate"] == pytest.approx(
+        (10 / 30) / 0.01, abs=0.01
+    )
+
+
+def test_slo_fast_burn_trips_only_past_min_events():
+    now = [5_000.0]
+    h = SLOHealth(time_fn=lambda: now[0])
+    # 5 errors: under min_events → cold-start guard holds, still healthy.
+    for i in range(5):
+        h.observe_event(_event("job_error", 5_000 - 10 + i))
+    assert h.snapshot()["healthy"]
+    assert h.check_breach() is None
+    # 10th error crosses the guard: burn 100 ≥ 14.4 → degraded.
+    for i in range(5):
+        h.observe_event(_event("job_error", 5_000 - 5 + i))
+    snap = h.snapshot()
+    assert not snap["healthy"]
+    kinds = {r["kind"] for r in snap["reasons"]}
+    assert "fast_burn" in kinds
+
+
+def test_breach_is_edge_triggered_and_rearms_on_recovery():
+    now = [7_000.0]
+    h = SLOHealth(time_fn=lambda: now[0])
+    for i in range(12):
+        h.observe_event(_event("job_error", 7_000 - 12 + i))
+    first = h.check_breach()
+    assert first is not None and first["reasons"]
+    assert h.check_breach() is None  # still breached: no re-fire
+    # Recovery: the bad minute ages out of every window.
+    now[0] += 2_000.0
+    for i in range(12):
+        h.observe_event(_event("done", now[0] - 12 + i))
+    assert h.check_breach() is None  # healthy again: re-armed, no fire
+    # A second burst fires a second edge.
+    now[0] += 2_000.0
+    for i in range(12):
+        h.observe_event(_event("job_error", now[0] - 12 + i))
+    assert h.check_breach() is not None
+    assert h.snapshot()["breaches"] == 2
+
+
+def test_latency_degradation_is_a_healthz_reason_not_a_breach():
+    now = [9_000.0]
+    h = SLOHealth(
+        SLOConfig(latency_target_s=0.5), time_fn=lambda: now[0]
+    )
+    for i in range(15):
+        h.observe_event(_event("done", 9_000 - 15 + i, wall_s=30.0))
+    healthy, body = h.healthz()
+    assert not healthy and body["status"] == "degraded"
+    assert any(r["kind"] == "latency" for r in body["reasons"])
+    # Latency alone never fires the burn-rate breach event.
+    assert h.check_breach() is None
+
+
+def test_stats_emits_slo_breach_event_once_per_edge(tmp_path):
+    sink = io.StringIO()
+    reg = MetricsRegistry()
+    health = SLOHealth(registry=reg)
+    stats = ServiceStats(sink, registry=reg, health=health)
+    for i in range(12):
+        stats.emit("job_error", job=i, reason="boom")
+    lines = [json.loads(l) for l in sink.getvalue().splitlines()]
+    breaches = [l for l in lines if l["ev"] == "slo_breach"]
+    assert len(breaches) == 1
+    assert breaches[0]["reasons"]
+    assert stats.snapshot()["slo_breaches"] == 1
+    assert not stats.snapshot()["slo"]["healthy"]
+    # More errors while already breached: no second event.
+    for i in range(5):
+        stats.emit("job_error", job=100 + i, reason="boom")
+    lines = [json.loads(l) for l in sink.getvalue().splitlines()]
+    assert len([l for l in lines if l["ev"] == "slo_breach"]) == 1
+
+
+# -- structured logger -------------------------------------------------------
+
+
+def test_logger_json_lines_carry_bound_and_call_fields():
+    buf = io.StringIO()
+    log = StructuredLogger(buf, fmt="json", component="verifyd")
+    log.info("hello", trace_id="abc", job_id=7)
+    rec = json.loads(buf.getvalue())
+    assert rec["msg"] == "hello" and rec["level"] == "info"
+    assert rec["component"] == "verifyd"
+    assert rec["trace_id"] == "abc" and rec["job_id"] == 7
+    assert "t" in rec
+
+
+def test_logger_text_format_and_level_filter():
+    buf = io.StringIO()
+    log = StructuredLogger(buf, fmt="text", level="warning")
+    log.debug("nope")
+    log.info("nope")
+    log.warning("careful", job_id=3)
+    lines = buf.getvalue().splitlines()
+    assert len(lines) == 1
+    assert "WARNING" in lines[0] and "careful" in lines[0]
+    assert "job_id=3" in lines[0]
+
+
+def test_logger_bind_derives_correlated_child():
+    buf = io.StringIO()
+    log = StructuredLogger(buf, fmt="json")
+    child = log.bind(trace_id="tid1")
+    child.info("from-child")
+    log.info("from-parent")
+    recs = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert recs[0]["trace_id"] == "tid1"
+    assert "trace_id" not in recs[1]
+
+
+def test_logger_survives_unserializable_fields_and_dead_streams():
+    buf = io.StringIO()
+    log = StructuredLogger(buf, fmt="json")
+    log.info("weird", obj=object())  # default=str handles it
+    rec = json.loads(buf.getvalue())
+    assert rec["msg"] == "weird"
+    closed = io.StringIO()
+    closed.close()
+    StructuredLogger(closed, fmt="text").info("lost")  # must not raise
+    assert StructuredLogger(buf, fmt="text").fmt == "text"
+    with pytest.raises(ValueError):
+        StructuredLogger(buf, fmt="yaml")
+
+
+# -- protocol compatibility (both directions) --------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _good() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    return _text(h)
+
+
+def test_old_client_against_new_daemon_gets_a_minted_trace_id(tmp_path):
+    """An old client never sends the trace field; the daemon mints an id
+    (every job has exactly one) and the reply still decodes fine."""
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        metrics_port=None,
+    )
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path)
+        # Simulate the old wire format: strip the trace field client-side.
+        real_call = client._call
+
+        def old_call(req, timeout=None):
+            req = {k: v for k, v in req.items() if k != TRACE_FIELD}
+            return real_call(req, timeout=timeout)
+
+        client._call = old_call
+        rep = client.submit(_good(), client="old")
+        assert rep["verdict"] == 0
+        # Daemon-minted id in the reply; the new-client setdefault did not
+        # clobber it (the daemon's word wins when present).
+        assert valid_trace_id(rep["trace_id"])
+        spans = [
+            e for e in client.trace()["traceEvents"] if e["ph"] == "X"
+        ]
+        tids = {
+            (e.get("args") or {}).get("trace_id")
+            for e in spans
+            if (e.get("args") or {}).get("trace_id")
+        }
+        assert rep["trace_id"] in tids
+
+
+def test_new_client_against_old_daemon_fills_trace_id_client_side(tmp_path):
+    """An old daemon echoes no trace_id; the client back-fills its own so
+    callers can correlate unconditionally."""
+    client = VerifydClient(str(tmp_path / "nowhere.sock"))
+    sent = {}
+
+    def old_daemon_call(req, timeout=None):
+        sent.update(req)
+        return {"verdict": 0, "outcome": "ok"}  # pre-trace reply shape
+
+    client._call = old_daemon_call
+    rep = client.submit(_good(), client="new")
+    # The new client DID send the optional field (old daemons ignore it)…
+    tid_sent, wall_sent = parse_trace_frame(sent[TRACE_FIELD])
+    assert valid_trace_id(tid_sent) and wall_sent is not None
+    # …and back-fills the reply with the id it minted.
+    assert rep["trace_id"] == tid_sent
+
+
+def test_submit_with_retry_keeps_one_trace_id_across_attempts(tmp_path):
+    client = VerifydClient(str(tmp_path / "nowhere.sock"))
+    seen = []
+
+    attempts = {"n": 0}
+
+    def flaky_call(req, timeout=None):
+        seen.append(parse_trace_frame(req[TRACE_FIELD])[0])
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            from s2_verification_tpu.service.client import VerifydRefused
+
+            raise VerifydRefused("ConnectionLost", "flaky")
+        return {"verdict": 0}
+
+    client._call = flaky_call
+    rep = client.submit_with_retry(_good(), retries=3, backoff_s=0.0)
+    assert rep["verdict"] == 0
+    assert len(seen) == 3 and len(set(seen)) == 1  # one logical request
+    assert rep["trace_id"] == seen[0]
+
+
+# -- /healthz and /slo HTTP surface ------------------------------------------
+
+
+def test_healthz_flips_503_with_reasons_and_slo_serves_snapshot():
+    reg = MetricsRegistry()
+    health = SLOHealth(registry=reg)
+    srv = MetricsServer(reg, port=0, health=health)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        resp = urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert resp.status == 200
+        assert json.loads(resp.read())["status"] == "ok"
+
+        slo = json.loads(
+            urllib.request.urlopen(base + "/slo", timeout=5).read()
+        )
+        assert slo["healthy"] and set(slo["windows"]) == {"1m", "5m", "30m"}
+
+        for i in range(12):
+            health.observe_event({"ev": "job_error"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/healthz", timeout=5)
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["status"] == "degraded" and body["reasons"]
+
+        # /metrics refresh pushed the degraded state into the gauges.
+        scrape = (
+            urllib.request.urlopen(base + "/metrics", timeout=5)
+            .read()
+            .decode()
+        )
+        assert "verifyd_slo_healthy 0" in scrape
+        assert "verifyd_slo_burn_rate" in scrape
+    finally:
+        srv.close()
+
+
+def test_metrics_server_without_health_keeps_legacy_healthz():
+    reg = MetricsRegistry()
+    srv = MetricsServer(reg, port=0)
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=5
+        )
+        assert resp.status == 200
+        assert resp.read() == b"ok\n"
+    finally:
+        srv.close()
