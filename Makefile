@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh perfgate clean
 
 all: native
 
@@ -46,6 +46,13 @@ chaos-full: obs mesh
 # integrity), the stats-op merge, and the Perfetto-loadable trace.
 obs:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/obs_check.py
+
+# Perf-regression gate (scripts/perf_watch.py): per-shape p95 EWMA drift
+# over service_bench history, the offline counterpart of the in-daemon
+# sentinel.  The selftest proves the gate end-to-end — a synthetically
+# slowed shape_key must exit nonzero, an in-band run must pass.
+perfgate:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/perf_watch.py --selftest
 
 # Multi-chip serving gate (scripts/mesh_check.py): 8 virtual CPU devices,
 # verifyd --mesh-devices 8 vs 1, same adversarial history through the

@@ -23,6 +23,11 @@
               its verdict (75 = queue full after retries, 69 = no daemon
               ever answered, 76 = a daemon was reached but refused after
               retries — bad secret, persistent frame errors).
+``profiles``— query the durable per-job profile archive: live against a
+              running daemon (``--socket``) or cold from a dead daemon's
+              ``--state-dir``; filter by shape/backend/client/verdict/
+              time, rank by wall time, export CSV/JSONL for offline
+              analysis (the learned-cost-model training set).
 
 Backends for ``check``:
 
@@ -511,6 +516,20 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             "--tcp requires a shared secret (--secret-file or VERIFYD_SECRET)"
         )
         return USAGE_EXIT
+    alert_rules: tuple = ()
+    if args.alert_rule:
+        from .obs.alerts import parse_rule
+
+        try:
+            alert_rules = tuple(args.alert_rule)
+            for spec in alert_rules:
+                parse_rule(spec)
+        except ValueError as e:
+            log.error("bad --alert-rule: %s", e)
+            return USAGE_EXIT
+        if not args.alert_url:
+            log.error("--alert-rule requires --alert-url")
+            return USAGE_EXIT
     mesh_devices = _resolve_mesh_devices(args.mesh_devices)
     if (
         mesh_devices is not None
@@ -544,6 +563,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         log_format=args.log_format,
         slo_target=args.slo_target,
         slo_latency_target_s=args.slo_latency_target,
+        alert_url=args.alert_url or None,
+        alert_rules=alert_rules,
+        alert_dedup_s=args.alert_dedup,
+        sentinel_band=args.sentinel_band,
+        sentinel_min_samples=args.sentinel_min_samples,
     )
     daemon = Verifyd(cfg)
 
@@ -594,6 +618,144 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     # Exit codes mirror the verdict: 0 clean shutdown, 1 unclean death —
     # scriptable ("did the last run die?") without parsing the report.
     return 0 if pm["clean_shutdown"] else 1
+
+
+#: export column order — stable so downstream scripts can rely on it.
+_PROFILE_COLUMNS = (
+    "t",
+    "job",
+    "client",
+    "shape",
+    "backend",
+    "verdict",
+    "wall_s",
+    "queue_wait_s",
+    "lease_wait_s",
+    "ops",
+    "shards",
+    "fp",
+)
+
+
+def _profile_filters(args: argparse.Namespace) -> dict:
+    return {
+        k: v
+        for k, v in {
+            "shape": args.shape,
+            "backend": args.backend,
+            "client": args.client,
+            "verdict": args.verdict,
+            "since": args.since,
+            "slowest": args.slowest,
+            "limit": args.limit,
+        }.items()
+        if v is not None
+    }
+
+
+def _export_profiles(records: list[dict], path, fmt: str) -> None:
+    import json as _json
+
+    if fmt == "jsonl":
+        for rec in records:
+            path.write(_json.dumps(rec, sort_keys=True))
+            path.write("\n")
+        return
+    import csv as _csv
+
+    w = _csv.writer(path)
+    w.writerow(_PROFILE_COLUMNS)
+    for rec in records:
+        w.writerow([rec.get(col, "") for col in _PROFILE_COLUMNS])
+
+
+def _cmd_profiles(args: argparse.Namespace) -> int:
+    """Query the durable job-profile archive, live or cold."""
+    filters = _profile_filters(args)
+    if args.socket:
+        from .service.client import (
+            VerifydClient,
+            VerifydError,
+            VerifydUnavailable,
+        )
+        from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+        try:
+            client = VerifydClient(args.socket, secret=_read_secret(args))
+        except ValueError as e:
+            log.error("%s", e)
+            return USAGE_EXIT
+        try:
+            reply = client.profiles(**filters)
+        except VerifydUnavailable as e:
+            log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+            return EXIT_UNAVAILABLE
+        except VerifydError as e:
+            log.error("profile query refused: %s", e)
+            return EXIT_PROTOCOL
+        except (OSError, TimeoutError) as e:
+            log.error("cannot reach verifyd on %s: %s", args.socket, e)
+            return EXIT_UNAVAILABLE
+        records = reply.get("records", [])
+        total = reply.get("total", len(records))
+    elif args.state_dir:
+        from .obs.archive import filter_records, read_archive
+
+        if not os.path.isdir(args.state_dir):
+            log.error("state dir %s does not exist", args.state_dir)
+            return USAGE_EXIT
+        archived = read_archive(args.state_dir)
+        records = filter_records(archived, **filters)
+        total = len(archived)
+    else:
+        log.error("profiles needs --socket (live) or --state-dir (cold)")
+        return USAGE_EXIT
+
+    if args.export:
+        fmt = args.format
+        if args.export == "-":
+            _export_profiles(records, sys.stdout, fmt)
+        else:
+            newline = "" if fmt == "csv" else None
+            with open(
+                args.export, "w", encoding="utf-8", newline=newline
+            ) as f:
+                _export_profiles(records, f, fmt)
+            log.info(
+                "exported %d of %d archived profiles to %s (%s)",
+                len(records),
+                total,
+                args.export,
+                fmt,
+            )
+        return 0
+
+    if not records:
+        print(f"no matching records ({total} archived)", flush=True)
+        return 0
+    hdr = (
+        f"{'when':19s} {'job':>6s} {'client':12s} {'shape':28s} "
+        f"{'backend':18s} {'vd':>2s} {'wall_ms':>9s} {'queue_ms':>9s} "
+        f"{'lease_ms':>9s}"
+    )
+    print(hdr)
+    for rec in records:
+        when = time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.localtime(float(rec.get("t", 0.0)))
+        )
+        wall = float(rec.get("wall_s") or 0.0) * 1e3
+        qw = float(rec.get("queue_wait_s") or 0.0) * 1e3
+        lw = float(rec.get("lease_wait_s") or 0.0) * 1e3
+        print(
+            f"{when:19s} {str(rec.get('job', '?')):>6s} "
+            f"{str(rec.get('client', '?')):12.12s} "
+            f"{str(rec.get('shape', '?')):28.28s} "
+            f"{str(rec.get('backend', '?')):18.18s} "
+            f"{str(rec.get('verdict', '?')):>2s} {wall:9.1f} {qw:9.1f} "
+            f"{lw:9.1f}"
+        )
+    print(f"-- {len(records)} of {total} archived records", flush=True)
+    return 0
 
 
 def _cmd_trace(args: argparse.Namespace) -> int:
@@ -990,6 +1152,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="end-to-end p95 latency target on the 1m window for "
         "/healthz degradation (default 5.0)",
     )
+    s.add_argument(
+        "--alert-url",
+        default=None,
+        metavar="URL",
+        help="deliver alertmanager-compatible JSON webhooks (slo_breach, "
+        "perf_regression, and --alert-rule matches) to this HTTP URL, "
+        "with exponential-backoff retries and per-rule dedup windows "
+        "(default: off)",
+    )
+    s.add_argument(
+        "--alert-rule",
+        action="append",
+        default=None,
+        metavar="SPEC",
+        help="additional alert rule (repeatable): an event name "
+        "('slo_breach'), a field threshold ('done.wall_s>30'), or a "
+        "metric threshold ('metric:verifyd_job_errors_total>=5'); "
+        "named like a builtin, it overrides that builtin",
+    )
+    s.add_argument(
+        "--alert-dedup",
+        type=float,
+        default=300.0,
+        metavar="SECONDS",
+        help="per-rule alert dedup window: repeat fires inside it are "
+        "suppressed (counted), not delivered (default 300)",
+    )
+    s.add_argument(
+        "--sentinel-band",
+        type=float,
+        default=0.75,
+        metavar="FRACTION",
+        help="perf-regression sentinel drift band: a shape whose wall "
+        "time exceeds its EWMA baseline by this fraction on consecutive "
+        "jobs emits perf_regression (default 0.75; <=0 disables the "
+        "sentinel)",
+    )
+    s.add_argument(
+        "--sentinel-min-samples",
+        type=int,
+        default=8,
+        metavar="N",
+        help="jobs per shape before the sentinel judges drift (default 8)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
 
     d = sub.add_parser(
@@ -1015,6 +1221,81 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit the full post-mortem as JSON instead of the report",
     )
     d.set_defaults(fn=_cmd_doctor)
+
+    pr = sub.add_parser(
+        "profiles",
+        help="query the durable job-profile archive (live --socket or "
+        "cold --state-dir): filter, rank by wall time, export CSV/JSONL",
+    )
+    pr.add_argument(
+        "-socket",
+        "--socket",
+        default=None,
+        help="query a running daemon: unix-socket path, or HOST:PORT for "
+        "the authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET)",
+    )
+    pr.add_argument(
+        "--state-dir",
+        default=None,
+        help="read a (dead) daemon's archive cold from its durable-state "
+        "directory — no daemon needed",
+    )
+    pr.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    pr.add_argument("--shape", default=None, help="exact shape_key match")
+    pr.add_argument(
+        "--backend",
+        default=None,
+        help="backend prefix match (e.g. 'device' matches device-mesh[4])",
+    )
+    pr.add_argument("--client", default=None, help="exact client identity")
+    pr.add_argument(
+        "--verdict",
+        type=int,
+        default=None,
+        help="verdict exit code (0 linearizable / 1 illegal / 2 unknown)",
+    )
+    pr.add_argument(
+        "--since",
+        type=float,
+        default=None,
+        metavar="EPOCH_S",
+        help="records at or after this epoch-seconds timestamp",
+    )
+    pr.add_argument(
+        "--slowest",
+        type=int,
+        default=None,
+        metavar="N",
+        help="N slowest by wall time (overrides --limit)",
+    )
+    pr.add_argument(
+        "--limit",
+        type=int,
+        default=None,
+        metavar="N",
+        help="newest N records (default 100 when neither --slowest nor "
+        "--limit is given)",
+    )
+    pr.add_argument(
+        "--export",
+        default=None,
+        metavar="FILE",
+        help="write matching records to FILE ('-' = stdout) instead of "
+        "the table",
+    )
+    pr.add_argument(
+        "--format",
+        default="jsonl",
+        choices=["jsonl", "csv"],
+        help="--export format (default jsonl)",
+    )
+    pr.set_defaults(fn=_cmd_profiles)
 
     t = sub.add_parser(
         "trace",

@@ -19,30 +19,50 @@ engines and verifyd hot paths:
                 job_id correlation fields.
 - ``flight``  — flight recorder: bounded on-disk ring of recent events +
                 spans (seglog-backed) and the doctor's post-mortem reader.
+- ``alerts``  — rule-driven AlertEngine delivering alertmanager-compatible
+                webhooks (backoff + jitter, dedup/re-arm) off the event
+                stream.
+- ``archive`` — durable per-job profile archive + deduplicated history
+                corpus over seglog: the replayable recorded-traffic set.
+- ``sentinel``— per-shape EWMA wall-time baselines emitting
+                ``perf_regression`` events when drift exceeds the band.
 
 Everything here is stdlib-only by design: the daemon must stay deployable
 on a bare TPU host image with no pip access.
 """
 
+from .alerts import AlertEngine, AlertRule, builtin_rules, parse_rule
+from .archive import ProfileArchive, filter_records, read_archive, read_corpus
 from .context import new_trace_id, valid_trace_id
 from .flight import FlightRecorder, postmortem, read_flight, render_postmortem
 from .health import SLOConfig, SLOHealth
 from .log import StructuredLogger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .sentinel import PerfSentinel, SentinelConfig
 from .trace import Tracer
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "Counter",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PerfSentinel",
+    "ProfileArchive",
     "SLOConfig",
     "SLOHealth",
+    "SentinelConfig",
     "StructuredLogger",
     "Tracer",
+    "builtin_rules",
+    "filter_records",
     "new_trace_id",
+    "parse_rule",
     "postmortem",
+    "read_archive",
+    "read_corpus",
     "read_flight",
     "render_postmortem",
     "valid_trace_id",

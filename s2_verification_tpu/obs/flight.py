@@ -9,11 +9,14 @@ storage discipline as the verdict cache) under
 
 - every ServiceStats event (fed by ServiceStats outside its sink lock),
 - every completed tracer span (via ``Tracer.span_hook``),
+- every alert the AlertEngine fires (``{"k": "alert"}`` records;
+  abandoned deliveries additionally leave an ``alert_failed`` dump
+  marker),
 - explicit **dump** records on SIGTERM / daemon close / SLO breach,
   carrying a full SLO snapshot at that instant.
 
-Each record is one JSON object ``{"k": "ev"|"span"|"dump", "t": wall,
-...}``.  Because every append is flushed, the tail survives SIGKILL up
+Each record is one JSON object ``{"k": "ev"|"span"|"alert"|"dump",
+"t": wall, ...}``.  Because every append is flushed, the tail survives SIGKILL up
 to the last OS write — exactly the property the doctor needs.
 
 :func:`postmortem` is the read side: point it at a dead daemon's
@@ -78,6 +81,11 @@ class FlightRecorder:
         if span.get("ph") != "X":
             return
         self._append({"k": "span", "t": round(time.time(), 6), **span})
+
+    def record_alert(self, alert: Dict[str, Any]) -> None:
+        """Absorb one fired alert (AlertEngine target); delivery failures
+        arrive separately as ``alert_failed`` dump markers."""
+        self._append({"k": "alert", "t": round(time.time(), 6), **alert})
 
     def dump(self, reason: str, **extra: Any) -> None:
         """Write a marker record (shutdown / sigterm / slo_breach) with
@@ -147,6 +155,7 @@ def postmortem(
     events = [r for r in records if r.get("k") == "ev"]
     spans = [r for r in records if r.get("k") == "span"]
     dumps = [r for r in records if r.get("k") == "dump"]
+    alerts = [r for r in records if r.get("k") == "alert"]
 
     # Open leases: grants never matched by a release/timeout of the same job.
     open_leases: Dict[Any, Dict[str, Any]] = {}
@@ -173,6 +182,14 @@ def postmortem(
     )[:slow]
 
     breaches = [d for d in dumps if d.get("reason") == "slo_breach"]
+    alert_failures = [d for d in dumps if d.get("reason") == "alert_failed"]
+
+    # Slowest archived jobs: the profile archive (PR 6) shares the state
+    # dir; a pre-archive daemon simply has none.
+    from .archive import filter_records, read_archive
+
+    slowest_jobs = filter_records(read_archive(state_dir), slowest=slow)
+
     last = records[-1] if records else None
     clean = bool(
         last
@@ -187,6 +204,9 @@ def postmortem(
         "spans": len(spans),
         "dumps": dumps,
         "breaches": breaches,
+        "alerts": alerts,
+        "alert_failures": alert_failures,
+        "slowest_jobs": slowest_jobs,
         "clean_shutdown": clean,
         "last_record": last,
         "tail": records[-tail:],
@@ -239,6 +259,36 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                 for r in reasons
             )
             add("  %s  %s" % (_fmt_t(b.get("t")), why or "(no detail)"))
+
+    if pm.get("alerts"):
+        add("")
+        add("-- alerts fired (last %d) --" % min(10, len(pm["alerts"])))
+        for a in pm["alerts"][-10:]:
+            add(
+                "  %s  %-16s rule=%s severity=%s"
+                % (
+                    _fmt_t(a.get("t")),
+                    a.get("event", "?"),
+                    a.get("rule"),
+                    a.get("severity", "?"),
+                )
+            )
+
+    if pm.get("alert_failures"):
+        add("")
+        add(
+            "-- alert deliveries abandoned: %d --" % len(pm["alert_failures"])
+        )
+        for d in pm["alert_failures"][-5:]:
+            add(
+                "  %s  rule=%s attempts=%s error=%s"
+                % (
+                    _fmt_t(d.get("t")),
+                    d.get("rule"),
+                    d.get("attempts"),
+                    d.get("error"),
+                )
+            )
 
     slo = pm["slo_at_death"]
     add("")
@@ -294,6 +344,22 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                 )
             )
 
+    if pm.get("slowest_jobs"):
+        add("")
+        add("-- slowest archived jobs --")
+        for r in pm["slowest_jobs"]:
+            add(
+                "  %8.1f ms  job=%s shape=%s backend=%s verdict=%s client=%s"
+                % (
+                    float(r.get("wall_s", 0.0) or 0.0) * 1000.0,
+                    r.get("job"),
+                    r.get("shape"),
+                    r.get("backend"),
+                    r.get("verdict"),
+                    r.get("client"),
+                )
+            )
+
     if pm["tail"]:
         add("")
         add("-- flight tail (last %d of %d) --" % (min(tail, len(pm["tail"])), pm["records"]))
@@ -319,6 +385,15 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                         rec.get("name", "?"),
                         float(rec.get("dur", 0.0)) / 1000.0,
                         rec.get("tid"),
+                    )
+                )
+            elif kind == "alert":
+                add(
+                    "  %s ALRT %-14s rule=%s"
+                    % (
+                        _fmt_t(rec.get("t")),
+                        rec.get("event", "?"),
+                        rec.get("rule"),
                     )
                 )
             else:

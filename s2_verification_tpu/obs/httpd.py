@@ -16,6 +16,8 @@ Endpoints:
   Without a health engine it degrades to the old static 200 "ok".
 - ``GET /slo`` — the full SLO snapshot (all windows, quantiles, burn
   rates, breach history) as JSON.
+- ``GET /sentinel`` — the perf-regression sentinel's per-shape EWMA
+  baselines and trip counts as JSON (404 without a sentinel).
 """
 
 from __future__ import annotations
@@ -29,6 +31,7 @@ from .metrics import MetricsRegistry
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (health ← metrics)
     from .health import SLOHealth
+    from .sentinel import PerfSentinel
 
 __all__ = ["MetricsServer", "CONTENT_TYPE"]
 
@@ -46,9 +49,11 @@ class MetricsServer:
         host: str = "127.0.0.1",
         *,
         health: "Optional[SLOHealth]" = None,
+        sentinel: "Optional[PerfSentinel]" = None,
     ) -> None:
         self.registry = registry
         self.health = health
+        self.sentinel = sentinel
 
         server = self
 
@@ -80,6 +85,13 @@ class MetricsServer:
                     )
                 elif path == "/slo" and server.health is not None:
                     snap = server.health.refresh()
+                    self._reply(
+                        200,
+                        (json.dumps(snap, sort_keys=True) + "\n").encode("utf-8"),
+                        _JSON_TYPE,
+                    )
+                elif path == "/sentinel" and server.sentinel is not None:
+                    snap = server.sentinel.snapshot()
                     self._reply(
                         200,
                         (json.dumps(snap, sort_keys=True) + "\n").encode("utf-8"),

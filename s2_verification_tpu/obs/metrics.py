@@ -257,6 +257,12 @@ class MetricsRegistry:
             Histogram, name, help, buckets=buckets, labelnames=labelnames
         )
 
+    def get(self, name: str) -> Optional[_Metric]:
+        """Registered metric by name, or None — read-only lookup for
+        consumers (alert threshold rules) that must not create series."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def render(self) -> str:
         """Prometheus text exposition format 0.0.4 (trailing newline included)."""
         with self._lock:

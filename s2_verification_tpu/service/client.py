@@ -214,6 +214,14 @@ class VerifydClient:
         """Fetch the daemon's span ring as Chrome trace_event JSON."""
         return self._call({"op": "trace"}, timeout=timeout)
 
+    def profiles(self, timeout: float | None = 10.0, **filters) -> dict:
+        """Query the daemon's durable profile archive.  Filters pass
+        through to the ``profiles`` op: shape, backend, client, verdict,
+        since, slowest, limit."""
+        req = {"op": "profiles"}
+        req.update({k: v for k, v in filters.items() if v is not None})
+        return self._call(req, timeout=timeout)
+
     def shutdown(self, timeout: float | None = 10.0) -> dict:
         return self._call({"op": "shutdown"}, timeout=timeout)
 

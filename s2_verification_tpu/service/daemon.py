@@ -36,12 +36,15 @@ from dataclasses import dataclass, field
 
 from .. import version as _version
 from ..checker.entries import prepare
+from ..obs.alerts import AlertEngine, builtin_rules, parse_rule
+from ..obs.archive import ARCHIVE_SUBDIR, ProfileArchive
 from ..obs.context import TRACE_FIELD, new_trace_id, parse_trace_frame
 from ..obs.flight import FLIGHT_SUBDIR, FlightRecorder
 from ..obs.health import SLOConfig, SLOHealth
 from ..obs.httpd import MetricsServer
 from ..obs.log import StructuredLogger
 from ..obs.metrics import MetricsRegistry
+from ..obs.sentinel import PerfSentinel, SentinelConfig
 from ..obs.trace import Tracer
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
@@ -130,6 +133,25 @@ class VerifydConfig:
     slo_target: float = 0.99
     #: end-to-end latency target (p95 on the short window) for /healthz
     slo_latency_target_s: float = 5.0
+    #: alert webhook URL (alertmanager-compatible POST target); None
+    #: disables the alert engine entirely
+    alert_url: str | None = None
+    #: extra --alert-rule specs (see obs/alerts.parse_rule); the
+    #: slo_breach + perf_regression built-ins always apply
+    alert_rules: tuple = ()
+    #: per-rule alert dedup window (a flapping signal pages once per
+    #: window; the rest count as suppressed)
+    alert_dedup_s: float = 300.0
+    #: delivery retries after the first attempt (exponential backoff
+    #: with full jitter between them)
+    alert_retries: int = 4
+    alert_backoff_s: float = 0.5
+    #: perf sentinel drift band: fire when a shape's wall time exceeds
+    #: its EWMA baseline by this fraction for consecutive jobs; <= 0
+    #: disables the sentinel
+    sentinel_band: float = 0.75
+    #: sentinel cold-start guard: per-shape jobs folded before judging
+    sentinel_min_samples: int = 8
     extra: dict = field(default_factory=dict)
 
 
@@ -174,17 +196,51 @@ class Verifyd:
             registry=self.registry,
         )
         self.flight = None
+        self.archive = None
         if config.state_dir:
             self.flight = FlightRecorder(
                 os.path.join(config.state_dir, FLIGHT_SUBDIR), fsync=config.fsync
             )
             self.tracer.span_hook = self.flight.record_span
+            self.archive = ProfileArchive(
+                os.path.join(config.state_dir, ARCHIVE_SUBDIR),
+                fsync=config.fsync,
+            )
+        self.sentinel = None
+        if config.sentinel_band > 0:
+            self.sentinel = PerfSentinel(
+                SentinelConfig(
+                    band=config.sentinel_band,
+                    min_samples=config.sentinel_min_samples,
+                ),
+                registry=self.registry,
+            )
+        self.alerts = None
+        if config.alert_url:
+            # User rules extend (never replace) the built-ins; a repeated
+            # spec keeps one state slot.
+            rules = {r.name: r for r in builtin_rules()}
+            for spec in config.alert_rules:
+                rule = parse_rule(spec)
+                rules[rule.name] = rule
+            self.alerts = AlertEngine(
+                config.alert_url,
+                rules.values(),
+                registry=self.registry,
+                recorder=self.flight,
+                retries=config.alert_retries,
+                backoff_s=config.alert_backoff_s,
+                dedup_s=config.alert_dedup_s,
+            )
         self.stats = ServiceStats(
             sink,
             registry=self.registry,
             health=self.health,
             recorder=self.flight,
             logger=stats_logger,
+            alerts=self.alerts,
+            archive=self.archive,
+            sentinel=self.sentinel,
         )
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
@@ -253,7 +309,10 @@ class Verifyd:
     def __enter__(self) -> "Verifyd":
         if self.cfg.metrics_port is not None:
             self._metrics_server = MetricsServer(
-                self.registry, self.cfg.metrics_port, health=self.health
+                self.registry,
+                self.cfg.metrics_port,
+                health=self.health,
+                sentinel=self.sentinel,
             )
             self.metrics_port = self._metrics_server.port
         self._recover_orphans()
@@ -290,8 +349,14 @@ class Verifyd:
             self._metrics_server.close()
         self.stats.emit("serve_stop", **self.stats.snapshot())
         self.dump_flight("shutdown")
+        if self.alerts is not None:
+            # Drain pending deliveries while the flight ring can still
+            # absorb alert_failed markers.
+            self.alerts.close()
         if self.flight is not None:
             self.flight.close()
+        if self.archive is not None:
+            self.archive.close()
         self.cache.close()
         if self.journal is not None:
             self.journal.close()
@@ -339,6 +404,8 @@ class Verifyd:
                 priority=job.priority,
                 history=text,
             )
+            if self.archive is not None:
+                self.archive.add_history(job.fingerprint, text)
             job.enqueued_at = self.tracer.now()
             try:
                 self.queue.put(job)
@@ -544,6 +611,38 @@ class Verifyd:
                 return ok(snap)
             if op == "trace":
                 return ok(self.tracer.export())
+            if op == "profiles":
+                if self.archive is None:
+                    return err(
+                        ERR_DECODE,
+                        "no profile archive (daemon runs without --state-dir)",
+                    )
+                filters = {}
+                for key in ("shape", "backend", "client"):
+                    if req.get(key) is not None:
+                        filters[key] = str(req[key])
+                for key in ("verdict", "slowest", "limit"):
+                    if req.get(key) is not None:
+                        try:
+                            filters[key] = int(req[key])
+                        except (TypeError, ValueError):
+                            return err(
+                                ERR_DECODE, f"{key} must be an int"
+                            )
+                if req.get("since") is not None:
+                    try:
+                        filters["since"] = float(req["since"])
+                    except (TypeError, ValueError):
+                        return err(ERR_DECODE, "since must be a number")
+                # Bound the reply frame unless the caller chose a cut.
+                if "limit" not in filters and "slowest" not in filters:
+                    filters["limit"] = 100
+                return ok(
+                    {
+                        "records": self.archive.query(**filters),
+                        "total": len(self.archive),
+                    }
+                )
             if op == "shutdown":
                 self.request_stop()
                 return ok({"stopping": True})
@@ -634,6 +733,10 @@ class Verifyd:
                 priority=priority,
                 history=text,
             )
+        if self.archive is not None:
+            # One corpus entry per fingerprint: the archived workload is
+            # replayable even after the stats sink is long gone.
+            self.archive.add_history(fingerprint, text)
         try:
             depth = self.queue.put(job)
         except QueueFull as e:

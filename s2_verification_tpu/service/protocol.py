@@ -31,6 +31,13 @@ Ops:
 ``trace``     → ``{"ok": {"traceEvents": [...], ...}}`` — the daemon's
                 in-memory span ring in Chrome trace_event JSON (Object
                 Format); loads directly in Perfetto / chrome://tracing.
+``profiles``  → query the durable per-job profile archive (requires
+                ``--state-dir``).  Optional filters: ``shape``,
+                ``backend`` (prefix match), ``client``, ``verdict``
+                (int), ``since`` (epoch s), ``slowest`` (N by wall
+                time), ``limit`` (newest N; defaults to 100 when no
+                other cut is given).  Reply:
+                ``{"ok": {"records": [...], "total": <archived>}}``.
 ``shutdown``  → acks, then stops the daemon.
 
 Frame bounds: the daemon reads at most ``MAX_FRAME_BYTES`` per frame

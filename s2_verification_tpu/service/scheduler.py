@@ -303,6 +303,10 @@ class Scheduler:
             shape=job.shape,
             shape_warm=warm,
             trace_id=job.trace_id,
+            # Archive/cost-model features: the fingerprint keys the job
+            # into the replay corpus, ops sizes it.
+            fingerprint=job.fingerprint,
+            ops=len(job.hist.ops),
         )
         if profile is not None:
             done_fields["profile"] = profile

@@ -19,7 +19,9 @@ admission), ``frame_error`` (oversized or malformed frame),
 ``stats_sink_lost`` (the event sink broke twice; counters survive);
 ``slo_breach`` (the SLO engine's edge-triggered burn-rate trip — emitted
 back onto this same stream so sinks, the flight recorder, and counters
-all see it).
+all see it); ``perf_regression`` (the sentinel's per-shape EWMA
+wall-time drift trip — also re-emitted onto the stream, where the alert
+engine routes it).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -41,9 +43,12 @@ from typing import IO, TYPE_CHECKING, Optional
 from ..obs.metrics import LATENCY_BUCKETS, LAYER_BUCKETS, MetricsRegistry
 
 if TYPE_CHECKING:  # pragma: no cover
+    from ..obs.alerts import AlertEngine
+    from ..obs.archive import ProfileArchive
     from ..obs.flight import FlightRecorder
     from ..obs.health import SLOHealth
     from ..obs.log import StructuredLogger
+    from ..obs.sentinel import PerfSentinel
 
 __all__ = ["ServiceStats"]
 
@@ -59,6 +64,9 @@ class ServiceStats:
         health: "Optional[SLOHealth]" = None,
         recorder: "Optional[FlightRecorder]" = None,
         logger: "Optional[StructuredLogger]" = None,
+        alerts: "Optional[AlertEngine]" = None,
+        archive: "Optional[ProfileArchive]" = None,
+        sentinel: "Optional[PerfSentinel]" = None,
     ) -> None:
         self._sink = sink
         #: SLO engine fed every event (outside the sink lock); its breach
@@ -69,7 +77,15 @@ class ServiceStats:
         #: structured logger; when set and no sink is configured, events
         #: flow through it instead of a raw stderr stream
         self.logger = logger
+        #: alert engine matching every event line against delivery rules
+        self.alerts = alerts
+        #: durable profile archive absorbing done events (+ lease waits)
+        self.archive = archive
+        #: perf sentinel; its drift trip re-enters emit() as
+        #: ``perf_regression``
+        self.sentinel = sentinel
         self._in_breach_emit = False
+        self._in_regression_emit = False
         self._lock = threading.Lock()
         self._t0 = time.time()
         self._counters: dict[str, int] = {
@@ -92,6 +108,7 @@ class ServiceStats:
             "leases_granted": 0,
             "lease_timeouts": 0,
             "slo_breaches": 0,
+            "perf_regressions": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -223,10 +240,28 @@ class ServiceStats:
             elif self.logger is not None:
                 self.logger.event(event, fields)
         # Observability consumers run outside the sink lock: neither the
-        # flight recorder's disk flush nor the SLO window math may extend
-        # the emit critical section every job passes through.
+        # flight recorder's disk flush, the archive append, nor the SLO
+        # window math may extend the emit critical section every job
+        # passes through.
         if self.recorder is not None:
             self.recorder.record_event(line)
+        if self.archive is not None:
+            self.archive.observe_event(line)
+        if self.sentinel is not None and not self._in_regression_emit:
+            regression = self.sentinel.observe_event(line)
+            if regression is not None:
+                # Re-entrant emit, same discipline as slo_breach: the
+                # regression rides the stream (sink, recorder, alert
+                # engine, counters).  The guard stops a regression from
+                # judging itself; the sentinel also only folds done
+                # events, so no feedback.
+                self._in_regression_emit = True
+                try:
+                    self.emit("perf_regression", **regression)
+                finally:
+                    self._in_regression_emit = False
+        if self.alerts is not None:
+            self.alerts.observe_event(line)
         if self.health is not None and not self._in_breach_emit:
             self.health.observe_event(line)
             breach = self.health.check_breach()
@@ -287,6 +322,8 @@ class ServiceStats:
             self._m_lease_timeouts.inc()
         elif event == "slo_breach":
             self._counters["slo_breaches"] += 1
+        elif event == "perf_regression":
+            self._counters["perf_regressions"] += 1
         elif event == "auth_reject":
             self._counters["auth_rejects"] += 1
             self._m_auth_rejects.inc()
@@ -368,6 +405,12 @@ class ServiceStats:
         snap["metrics"] = self.registry.snapshot()
         if self.health is not None:
             snap["slo"] = self.health.snapshot()
+        if self.sentinel is not None:
+            snap["sentinel"] = self.sentinel.snapshot()
+        if self.alerts is not None:
+            snap["alerts"] = self.alerts.snapshot()
+        if self.archive is not None:
+            snap["archive"] = self.archive.snapshot()
         return snap
 
     def retry_after_hint(self, queue_depth: int) -> float:

@@ -24,7 +24,18 @@ What it checks (the `make obs` gate):
    ``slo_breach`` event/counter fires;
 7. distributed trace stitching: one supervised-escalated job's trace must
    carry client-, daemon-, AND child-origin spans under a single
-   ``trace_id`` on the job's track, with no negative durations.
+   ``trace_id`` on the job's track, with no negative durations;
+8. alert delivery: an induced failure burst against a daemon with
+   ``--alert-url`` must deliver exactly ONE deduplicated
+   alertmanager-compatible webhook to a fake receiver — retrying through
+   an injected 503 on the first attempt — and a second synthetic breach
+   inside the dedup window must be suppressed, not delivered;
+9. profile archive durability: records archived under ``--state-dir``
+   must answer the ``profiles`` op again after a daemon restart, and
+   read cold (no daemon) with the history corpus intact;
+10. perf sentinel: a synthetic slowdown on one shape_key pushed through
+    the live event stream must fire ``perf_regression`` (counter + the
+    ``/sentinel`` endpoint's per-shape state).
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
 Pure stdlib + the package; runs on CPU in under a minute.
@@ -542,6 +553,197 @@ def main() -> int:
     finally:
         sched_mod._cpu_check = real_cpu_check
 
+    # -- alerts phase: breach → exactly one deduplicated webhook ------------
+    import http.server
+    import threading
+
+    received: list = []
+    attempts = [0]
+
+    class _AlertReceiver(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802 - stdlib handler name
+            attempts[0] += 1
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            if attempts[0] == 1:
+                # Injected transient failure: the engine must retry.
+                self.send_response(503)
+                self.end_headers()
+                return
+            received.append(json.loads(body.decode("utf-8")))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):  # noqa: D102 - silence per-request lines
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _AlertReceiver)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    alert_url = f"http://127.0.0.1:{httpd.server_address[1]}/alert"
+
+    sched_mod._cpu_check = _boom
+    logging.getLogger("s2_verification_tpu").setLevel(logging.CRITICAL)
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-alerts-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="off",
+                alert_url=alert_url,
+                alert_backoff_s=0.05,
+            )
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                for i in range(12):
+                    try:
+                        client.submit(texts[i % len(texts)], client="alerts")
+                    except VerifydError:
+                        pass
+                if not client.stats().get("slo_breaches"):
+                    return _fail("alert phase: burst never fired slo_breach")
+                daemon.alerts.flush(timeout=30.0)
+                if attempts[0] < 2:
+                    return _fail(
+                        f"alert engine gave up after the injected 503 "
+                        f"({attempts[0]} attempts)"
+                    )
+                if len(received) != 1:
+                    return _fail(
+                        f"expected exactly 1 deduplicated delivery, "
+                        f"got {len(received)} over {attempts[0]} attempts"
+                    )
+                # A second breach inside the dedup window: suppressed,
+                # not delivered.
+                daemon.stats.emit("slo_breach", reason="obs-check-dedup")
+                daemon.alerts.flush(timeout=30.0)
+                if len(received) != 1:
+                    return _fail(
+                        f"dedup window leaked a second delivery "
+                        f"({len(received)} received)"
+                    )
+                asnap = daemon.alerts.snapshot()
+                rule = asnap["rules"].get("slo_breach", {})
+                if not rule.get("suppressed"):
+                    return _fail(
+                        f"suppressed counter never moved: {asnap}"
+                    )
+                payload = received[0]
+                if not isinstance(payload, list) or not payload:
+                    return _fail(f"webhook payload is not an alert list: {payload}")
+                alert = payload[0]
+                labels = alert.get("labels") or {}
+                if labels.get("alertname") != "slo_breach":
+                    return _fail(f"wrong alertname in payload: {labels}")
+                if labels.get("service") != "verifyd":
+                    return _fail(f"payload lacks the service label: {labels}")
+                if not alert.get("startsAt") or "T" not in alert["startsAt"]:
+                    return _fail(f"startsAt is not RFC3339: {alert}")
+                if not (alert.get("annotations") or {}).get("summary"):
+                    return _fail(f"payload lacks an annotation summary: {alert}")
+                alerts_delivered = len(received)
+                alert_attempts = attempts[0]
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+        logging.getLogger("s2_verification_tpu").setLevel(logging.NOTSET)
+        httpd.shutdown()
+
+    # -- archive phase: profiles survive a daemon restart, read cold --------
+    from s2_verification_tpu.obs.archive import read_archive, read_corpus
+
+    with tempfile.TemporaryDirectory(prefix="obs-check-archive-") as d:
+        sock = os.path.join(d, "verifyd.sock")
+        state = os.path.join(d, "state")
+        cfg = VerifydConfig(
+            socket_path=sock,
+            out_dir=os.path.join(d, "viz"),
+            no_viz=True,
+            stats_log=None,
+            device="off",
+            state_dir=state,
+        )
+        with Verifyd(cfg):
+            client = VerifydClient(sock)
+            for i, text in enumerate(texts):
+                client.submit(text, client=f"archive{i}")
+            live = client.profiles()
+            if live.get("total") != len(texts):
+                return _fail(
+                    f"live profiles op archived {live.get('total')} of "
+                    f"{len(texts)} jobs"
+                )
+        # Cold: no daemon, straight off the segment logs.
+        cold = read_archive(state)
+        if len(cold) != len(texts):
+            return _fail(f"cold archive read found {len(cold)}/{len(texts)}")
+        corpus = read_corpus(state)
+        missing = [r["fp"] for r in cold if r.get("fp") not in corpus]
+        if missing:
+            return _fail(f"archived records lack corpus histories: {missing}")
+        if not all(r.get("wall_s") is not None and r.get("shape") for r in cold):
+            return _fail(f"cold records missing profile fields: {cold}")
+        # Restart on the same state dir: the archive must replay.
+        with Verifyd(cfg):
+            client = VerifydClient(sock)
+            after = client.profiles()
+            if after.get("total") != len(texts):
+                return _fail(
+                    f"restarted daemon lists {after.get('total')} archived "
+                    f"jobs, want {len(texts)}"
+                )
+            archived = after["total"]
+
+    # -- sentinel phase: synthetic slowdown must fire perf_regression -------
+    with tempfile.TemporaryDirectory(prefix="obs-check-sentinel-") as d:
+        sock = os.path.join(d, "verifyd.sock")
+        cfg = VerifydConfig(
+            socket_path=sock,
+            out_dir=os.path.join(d, "viz"),
+            no_viz=True,
+            stats_log=None,
+            device="off",
+            metrics_port=0,
+            sentinel_min_samples=4,
+        )
+        with Verifyd(cfg) as daemon:
+            # Synthetic slowdown injected at the event-stream seam: the
+            # same ServiceStats.emit the scheduler calls, so the fold,
+            # the perf_regression re-emit, the counter, and the HTTP
+            # surface are all the production path.
+            for _ in range(8):
+                daemon.stats.emit(
+                    "done", shape="obs-sentinel", backend="native",
+                    wall_s=0.02, verdict=0,
+                )
+            for _ in range(4):
+                daemon.stats.emit(
+                    "done", shape="obs-sentinel", backend="native",
+                    wall_s=0.4, verdict=0,
+                )
+            client = VerifydClient(sock)
+            snap = client.stats()
+            if not snap.get("perf_regressions"):
+                return _fail(
+                    f"synthetic 20x slowdown never fired perf_regression: "
+                    f"{snap.get('perf_regressions')}"
+                )
+            sent = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{daemon.metrics_port}/sentinel",
+                    timeout=5,
+                )
+                .read()
+                .decode("utf-8")
+            )
+            shape_state = (sent.get("shapes") or {}).get("obs-sentinel")
+            if not shape_state or not shape_state.get("regressions"):
+                return _fail(f"/sentinel shows no regression: {sent}")
+            if not sent.get("regressions"):
+                return _fail(f"/sentinel total regressions is zero: {sent}")
+            regressions = sent["regressions"]
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
         f"{len(spans)} spans, {len(profiled)} profiled jobs, "
@@ -549,7 +751,9 @@ def main() -> int:
         f"{len(shard_labels)} shards ({backend}), "
         f"{len(REQUIRED_SLO_FAMILIES)} SLO families, healthz flipped 503 "
         f"after {errors} induced errors, {stitched} spans stitched under "
-        f"one trace id"
+        f"one trace id, {alerts_delivered} webhook delivered in "
+        f"{alert_attempts} attempts (dedup held), {archived} profiles "
+        f"survived restart, {regressions} sentinel regression(s)"
     )
     return 0
 

@@ -1,0 +1,361 @@
+"""Perf-regression gate over service_bench runs (`make perfgate`).
+
+The in-daemon sentinel (obs/sentinel.py) watches per-shape wall-time
+drift *live*; this script is its offline counterpart for CI: compare a
+fresh ``scripts/service_bench.py`` BENCH line against
+
+1. the per-shape p95 EWMA folded from a **history file** of prior BENCH
+   lines (JSONL, one run per line) — a shape whose p95 exceeds its
+   baseline by more than ``--band`` is flagged (the same
+   ``ewma_drift`` predicate the live sentinel uses, so online and
+   offline agree on what "regressed" means); and
+2. optionally the published ``BASELINE.json`` aggregate throughput
+   (``--min-vs-baseline``, off by default — cross-machine absolute
+   numbers are advisory, per-shape relative drift is the gate).
+
+On a passing run the BENCH line is appended to the history file, so the
+baseline tracks gradual legitimate change; regressing runs are *not*
+folded in (a regression must not poison its own baseline).
+
+Usage:
+    python scripts/perf_watch.py [--history FILE] [--band F]
+        [--run-json FILE] [--min-runs N] [--min-vs-baseline F]
+        [--bench-args "..."] [--no-record] [--selftest]
+
+``--run-json FILE`` scores a pre-recorded BENCH line instead of running
+the bench (offline mode, and what ``--selftest`` uses underneath).
+``--selftest`` proves the gate end-to-end in a temp dir: a synthetic
+stable history, then a run with one shape's p95 slowed ~10x, must exit
+nonzero naming that shape; an in-band control run must exit 0.
+
+Exit codes: 0 clean, 1 regression flagged, 64 usage/bench failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from s2_verification_tpu.obs.sentinel import ewma_drift  # noqa: E402
+
+#: EWMA fold weight per historical run (few samples, so heavier than the
+#: live sentinel's per-job alpha).
+ALPHA = 0.3
+#: p95s under this are scheduler noise, never a regression (ms).
+FLOOR_MS = 2.0
+DEFAULT_HISTORY = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "data",
+    "perf_history.jsonl",
+)
+
+
+def load_history(path: str) -> list[dict]:
+    runs: list[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(obj, dict):
+                    runs.append(obj)
+    except OSError:
+        pass
+    return runs
+
+
+def shape_baselines(runs: list[dict]) -> dict[str, dict]:
+    """Fold per-shape p95 EWMAs over the run history, oldest first."""
+    base: dict[str, dict] = {}
+    for run in runs:
+        for shape, q in (run.get("shapes") or {}).items():
+            try:
+                p95 = float(q.get("p95_ms"))
+            except (TypeError, ValueError):
+                continue
+            st = base.get(shape)
+            if st is None:
+                base[shape] = {"p95_ms": p95, "runs": 1}
+            else:
+                st["p95_ms"] += ALPHA * (p95 - st["p95_ms"])
+                st["runs"] += 1
+    return base
+
+
+def compare(
+    run: dict,
+    baselines: dict[str, dict],
+    *,
+    band: float,
+    min_runs: int,
+    floor_ms: float = FLOOR_MS,
+) -> list[dict]:
+    """Per-shape drift verdicts for one BENCH line.  A shape with no
+    baseline (new shape, or fewer than ``min_runs`` historical runs) is
+    never flagged — cold starts are not regressions."""
+    regressions = []
+    for shape, q in sorted((run.get("shapes") or {}).items()):
+        st = baselines.get(shape)
+        if st is None or st["runs"] < min_runs:
+            continue
+        try:
+            p95 = float(q.get("p95_ms"))
+        except (TypeError, ValueError):
+            continue
+        if p95 > floor_ms and ewma_drift(p95, st["p95_ms"], band):
+            regressions.append(
+                {
+                    "shape": shape,
+                    "p95_ms": round(p95, 2),
+                    "baseline_p95_ms": round(st["p95_ms"], 2),
+                    "ratio": round(p95 / st["p95_ms"], 2)
+                    if st["p95_ms"] > 0
+                    else 0.0,
+                    "runs": st["runs"],
+                }
+            )
+    return regressions
+
+
+def _run_bench(extra_args: list[str]) -> dict | None:
+    cmd = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "service_bench.py"),
+        "--seed-collect",
+    ] + extra_args
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        return None
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    return None
+
+
+def _selftest() -> int:
+    """Prove the gate fires: synthetic stable history, one shape slowed
+    ~10x → nonzero exit naming the shape; in-band control → exit 0."""
+    me = os.path.abspath(__file__)
+    with tempfile.TemporaryDirectory(prefix="perf-watch-selftest-") as tmp:
+        history = os.path.join(tmp, "history.jsonl")
+        shapes = {"16x3x8": 20.0, "32x5x16": 45.0}
+        with open(history, "w", encoding="utf-8") as f:
+            for i in range(5):
+                line = {
+                    "metric": "service_jobs_per_sec",
+                    "value": 100.0,
+                    "shapes": {
+                        s: {
+                            "n": 30,
+                            "p50_ms": v * 0.8,
+                            "p95_ms": v + 0.1 * i,
+                            "p99_ms": v * 1.2,
+                        }
+                        for s, v in shapes.items()
+                    },
+                }
+                f.write(json.dumps(line) + "\n")
+
+        def gate(run: dict) -> subprocess.CompletedProcess:
+            run_path = os.path.join(tmp, "run.json")
+            with open(run_path, "w", encoding="utf-8") as f:
+                json.dump(run, f)
+            return subprocess.run(
+                [
+                    sys.executable,
+                    me,
+                    "--run-json",
+                    run_path,
+                    "--history",
+                    history,
+                    "--no-record",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=60,
+            )
+
+        slow = {
+            "metric": "service_jobs_per_sec",
+            "value": 40.0,
+            "shapes": {
+                "16x3x8": {"n": 30, "p50_ms": 150.0, "p95_ms": 200.0,
+                           "p99_ms": 240.0},
+                "32x5x16": {"n": 30, "p50_ms": 36.0, "p95_ms": 45.2,
+                            "p99_ms": 54.0},
+            },
+        }
+        proc = gate(slow)
+        if proc.returncode == 0:
+            print("selftest FAILED: slowed shape not flagged", file=sys.stderr)
+            sys.stderr.write(proc.stdout + proc.stderr)
+            return 1
+        if "16x3x8" not in proc.stdout + proc.stderr:
+            print(
+                "selftest FAILED: regression report does not name the "
+                "slowed shape",
+                file=sys.stderr,
+            )
+            sys.stderr.write(proc.stdout + proc.stderr)
+            return 1
+        ok = {
+            "metric": "service_jobs_per_sec",
+            "value": 100.0,
+            "shapes": {
+                s: {"n": 30, "p50_ms": v * 0.8, "p95_ms": v * 1.02,
+                    "p99_ms": v * 1.2}
+                for s, v in shapes.items()
+            },
+        }
+        proc = gate(ok)
+        if proc.returncode != 0:
+            print("selftest FAILED: in-band run flagged", file=sys.stderr)
+            sys.stderr.write(proc.stdout + proc.stderr)
+            return 1
+    print(
+        "perf_watch selftest ok: slowed shape flagged (exit nonzero), "
+        "in-band run passed",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--history",
+        default=DEFAULT_HISTORY,
+        help="JSONL of prior BENCH lines (per-shape EWMA baselines); "
+        "passing runs are appended (see --no-record)",
+    )
+    ap.add_argument(
+        "--band",
+        type=float,
+        default=0.75,
+        help="drift band: flag a shape whose p95 exceeds its EWMA "
+        "baseline by more than this fraction (default 0.75)",
+    )
+    ap.add_argument(
+        "--min-runs",
+        type=int,
+        default=3,
+        help="historical runs per shape before it is judged (default 3)",
+    )
+    ap.add_argument(
+        "--min-vs-baseline",
+        type=float,
+        default=0.0,
+        help="also require run jobs/s >= this fraction of the published "
+        "BASELINE.json service_jobs_per_sec (0 = skip, the default — "
+        "absolute cross-machine numbers are advisory)",
+    )
+    ap.add_argument(
+        "--run-json",
+        default=None,
+        metavar="FILE",
+        help="score this pre-recorded BENCH line instead of running "
+        "service_bench",
+    )
+    ap.add_argument(
+        "--bench-args",
+        default="",
+        help="extra args for the service_bench run (shell-split)",
+    )
+    ap.add_argument(
+        "--no-record",
+        action="store_true",
+        help="do not append a passing run to the history file",
+    )
+    ap.add_argument(
+        "--selftest",
+        action="store_true",
+        help="prove the gate fires on a synthetic slowdown and stays "
+        "quiet in-band (temp dir; exits 0 when both hold)",
+    )
+    args = ap.parse_args()
+
+    if args.selftest:
+        return _selftest()
+
+    if args.run_json:
+        try:
+            with open(args.run_json, encoding="utf-8") as f:
+                run = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"# cannot read --run-json: {e}", file=sys.stderr)
+            return 64
+    else:
+        import shlex
+
+        run = _run_bench(shlex.split(args.bench_args))
+        if run is None:
+            print("# service_bench produced no BENCH line", file=sys.stderr)
+            return 64
+
+    history = load_history(args.history)
+    baselines = shape_baselines(history)
+    regressions = compare(
+        run, baselines, band=args.band, min_runs=args.min_runs
+    )
+
+    slow_vs_published = None
+    if args.min_vs_baseline > 0:
+        vs = run.get("vs_baseline")
+        if vs and float(vs) < args.min_vs_baseline:
+            slow_vs_published = float(vs)
+
+    report = {
+        "metric": "perf_watch",
+        "jobs_per_sec": run.get("value"),
+        "band": args.band,
+        "history_runs": len(history),
+        "shapes_judged": sum(
+            1 for st in baselines.values() if st["runs"] >= args.min_runs
+        ),
+        "regressions": regressions,
+    }
+    if slow_vs_published is not None:
+        report["vs_baseline"] = slow_vs_published
+    print(json.dumps(report), flush=True)
+    for r in regressions:
+        print(
+            f"# REGRESSION shape={r['shape']}: p95 {r['p95_ms']}ms vs "
+            f"baseline {r['baseline_p95_ms']}ms (x{r['ratio']}, "
+            f"{r['runs']} runs of history)",
+            file=sys.stderr,
+        )
+    if slow_vs_published is not None:
+        print(
+            f"# REGRESSION aggregate: vs_baseline {slow_vs_published} < "
+            f"--min-vs-baseline {args.min_vs_baseline}",
+            file=sys.stderr,
+        )
+
+    failed = bool(regressions) or slow_vs_published is not None
+    if not failed and not args.no_record:
+        os.makedirs(os.path.dirname(args.history) or ".", exist_ok=True)
+        with open(args.history, "a", encoding="utf-8") as f:
+            f.write(json.dumps(run, sort_keys=True) + "\n")
+        print(
+            f"# recorded run into {args.history} "
+            f"({len(history) + 1} runs)",
+            file=sys.stderr,
+        )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,13 @@ from s2_verification_tpu.service.client import (
 )
 
 
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank quantile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(len(sorted_vals) * q))]
+
+
 def _host_cpus() -> int:
     try:
         return len(os.sched_getaffinity(0))
@@ -191,6 +198,7 @@ def main() -> int:
     lock = threading.Lock()
     cursor = [0]
     lat: list[float] = []
+    shape_lat: dict[str, list[float]] = {}
     cached_n = [0]
     rejects = [0]
     errors: list[str] = []
@@ -223,6 +231,9 @@ def main() -> int:
             dt = time.monotonic() - t0
             with lock:
                 lat.append(dt)
+                shape_lat.setdefault(
+                    str(reply.get("shape") or "?"), []
+                ).append(dt)
                 if reply.get("cached"):
                     cached_n[0] += 1
 
@@ -243,8 +254,22 @@ def main() -> int:
             return 1
         done = len(lat)
         lat.sort()
-        p50 = lat[len(lat) // 2]
-        p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+        p50 = _quantile(lat, 0.5)
+        p95 = _quantile(lat, 0.95)
+        p99 = _quantile(lat, 0.99)
+        # Per-shape quantiles: the perf-regression sentinel's offline
+        # counterpart — scripts/perf_watch.py compares these per shape
+        # against baseline history, so a regression confined to one
+        # shape_key is not averaged away by the aggregate row.
+        shapes = {}
+        for shape in sorted(shape_lat):
+            vals = sorted(shape_lat[shape])
+            shapes[shape] = {
+                "n": len(vals),
+                "p50_ms": round(_quantile(vals, 0.5) * 1e3, 2),
+                "p95_ms": round(_quantile(vals, 0.95) * 1e3, 2),
+                "p99_ms": round(_quantile(vals, 0.99) * 1e3, 2),
+            }
         print(
             f"# {done} verdicts in {wall:.2f}s; latency p50 {p50 * 1e3:.1f}ms "
             f"p95 {p95 * 1e3:.1f}ms; {cached_n[0]} cache hits; "
@@ -272,6 +297,8 @@ def main() -> int:
             "rejects": rejects[0],
             "p50_ms": round(p50 * 1e3, 2),
             "p95_ms": round(p95 * 1e3, 2),
+            "p99_ms": round(p99 * 1e3, 2),
+            "shapes": shapes,
         }
         if mesh is not None:
             line["mesh_devices"] = mesh

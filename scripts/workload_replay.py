@@ -1,0 +1,232 @@
+"""Replay an archived workload against a live verifyd and score it.
+
+The profile archive (``verifyd --state-dir``, obs/archive.py) stores two
+things: every finished job's profile record and the history corpus keyed
+by fingerprint.  Together they are a *replayable workload*: this script
+re-submits each archived history — same bytes, same arrival order —
+against a daemon and compares what comes back:
+
+* **verdict parity** per fingerprint (the correctness bar: a replay that
+  decides differently than the recorded run is a red flag, except for
+  recorded UNKNOWNs — budget-dependent verdicts may legitimately resolve
+  on a different machine);
+* **throughput and wall-time deltas** (the perf bar: the recorded run's
+  avg wall time vs. the replay's, plus replay jobs/s).
+
+With ``--socket`` it attaches to a running daemon; otherwise it spawns a
+fresh in-process daemon (CPU portfolio, fresh state, no viz) so the
+replay is self-contained — the before/after harness for scheduler or
+engine changes: archive a production window, change the code, replay.
+
+Usage:
+    python scripts/workload_replay.py --state-dir DIR [--socket PATH]
+        [--concurrency N] [--limit N] [--shape KEY] [--time-budget S]
+
+Output: one JSON line on stdout
+    {"metric": "replay_jobs_per_sec", "value": ..., "jobs": ...,
+     "mismatches": ..., "skipped": ..., "recorded_avg_wall_s": ...,
+     "replay_avg_wall_s": ..., "wall_ratio": ...}
+Exit 0 on full parity, 1 on any verdict mismatch, 64 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from s2_verification_tpu.obs.archive import (  # noqa: E402
+    filter_records,
+    read_archive,
+    read_corpus,
+)
+from s2_verification_tpu.service.client import (  # noqa: E402
+    VerifydBusy,
+    VerifydClient,
+    VerifydError,
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--state-dir",
+        required=True,
+        help="the archiving daemon's durable-state directory",
+    )
+    ap.add_argument(
+        "--socket",
+        default=None,
+        help="replay against a live daemon (default: spawn an in-process "
+        "daemon with a fresh temp state)",
+    )
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument(
+        "--limit",
+        type=int,
+        default=None,
+        help="replay only the newest N archived jobs",
+    )
+    ap.add_argument("--shape", default=None, help="replay one shape_key only")
+    ap.add_argument("--time-budget", type=float, default=10.0)
+    args = ap.parse_args()
+
+    if not os.path.isdir(args.state_dir):
+        print(f"# state dir {args.state_dir} does not exist", file=sys.stderr)
+        return 64
+    records = read_archive(args.state_dir)
+    corpus = read_corpus(args.state_dir)
+    if args.shape or args.limit:
+        records = filter_records(
+            records, shape=args.shape, limit=args.limit
+        )
+    if not records:
+        print(f"# nothing archived under {args.state_dir}", file=sys.stderr)
+        return 64
+
+    # The workload: archived records in their recorded order, each with
+    # its history text.  A record whose corpus entry is missing (archive
+    # predates corpus capture, or the corpus ring dropped it) is skipped
+    # and counted — silence would overstate coverage.
+    work: list[dict] = []
+    skipped = 0
+    for rec in records:
+        text = corpus.get(rec.get("fp", ""))
+        if text is None:
+            skipped += 1
+            continue
+        work.append({"rec": rec, "text": text})
+    if not work:
+        print(
+            f"# no archived histories to replay ({skipped} records had no "
+            "corpus entry)",
+            file=sys.stderr,
+        )
+        return 64
+    print(
+        f"# replaying {len(work)} archived jobs "
+        f"({skipped} skipped, no corpus entry), "
+        f"{args.concurrency} submitters",
+        file=sys.stderr,
+    )
+
+    daemon_ctx = None
+    if args.socket:
+        sock = args.socket
+    else:
+        from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+
+        tmp = tempfile.mkdtemp(prefix="workload-replay-")
+        sock = os.path.join(tmp, "verifyd.sock")
+        daemon_ctx = Verifyd(
+            VerifydConfig(
+                socket_path=sock,
+                device="off",
+                no_viz=True,
+                time_budget_s=args.time_budget,
+                out_dir=os.path.join(tmp, "viz"),
+                stats_log=None,
+            )
+        )
+        daemon_ctx.__enter__()
+
+    lock = threading.Lock()
+    cursor = [0]
+    mismatches: list[dict] = []
+    replay_walls: list[float] = []
+    errors: list[str] = []
+
+    def submitter(worker_id: int) -> None:
+        client = VerifydClient(sock)
+        while True:
+            with lock:
+                if cursor[0] >= len(work):
+                    return
+                item = work[cursor[0]]
+                cursor[0] += 1
+            rec = item["rec"]
+            try:
+                while True:
+                    try:
+                        reply = client.submit(
+                            item["text"],
+                            client=f"replay{worker_id}",
+                            no_viz=True,
+                        )
+                        break
+                    except VerifydBusy as e:
+                        time.sleep(min(e.retry_after_s, 5.0))
+            except (VerifydError, OSError) as e:
+                with lock:
+                    errors.append(repr(e))
+                return
+            with lock:
+                replay_walls.append(float(reply.get("wall_s") or 0.0))
+                recorded = rec.get("verdict")
+                got = reply.get("verdict")
+                # Recorded UNKNOWN (2) is budget-dependent, not a parity
+                # failure; any decided verdict must replay identically.
+                if recorded in (0, 1) and got != recorded:
+                    mismatches.append(
+                        {
+                            "fp": rec.get("fp"),
+                            "shape": rec.get("shape"),
+                            "recorded": recorded,
+                            "replayed": got,
+                        }
+                    )
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=submitter, args=(i,), daemon=True)
+        for i in range(args.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    try:
+        if errors:
+            print(f"# {len(errors)} submitter errors: {errors[:3]}", file=sys.stderr)
+            return 1
+        recorded_walls = [
+            float(it["rec"].get("wall_s") or 0.0) for it in work
+        ]
+        rec_avg = sum(recorded_walls) / len(recorded_walls)
+        rep_avg = (
+            sum(replay_walls) / len(replay_walls) if replay_walls else 0.0
+        )
+        for m in mismatches[:10]:
+            print(
+                f"# PARITY MISMATCH {m['fp']} shape={m['shape']}: "
+                f"recorded {m['recorded']} != replayed {m['replayed']}",
+                file=sys.stderr,
+            )
+        line = {
+            "metric": "replay_jobs_per_sec",
+            "value": round(len(replay_walls) / wall, 2) if wall > 0 else 0.0,
+            "unit": "jobs/s",
+            "jobs": len(replay_walls),
+            "mismatches": len(mismatches),
+            "skipped": skipped,
+            "recorded_avg_wall_s": round(rec_avg, 5),
+            "replay_avg_wall_s": round(rep_avg, 5),
+            # >1 = the replay runs slower per job than the recorded run
+            "wall_ratio": round(rep_avg / rec_avg, 3) if rec_avg > 0 else 0.0,
+        }
+        print(json.dumps(line), flush=True)
+        return 1 if mismatches else 0
+    finally:
+        if daemon_ctx is not None:
+            daemon_ctx.__exit__(None, None, None)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,302 @@
+"""AlertEngine: rule grammar, delivery, backoff, dedup, edge triggering.
+
+All delivery tests run against a real stdlib HTTP receiver on an
+ephemeral loopback port whose responses are scripted per attempt, so the
+retry/backoff path exercises actual sockets; clocks and sleeps are
+injected so no test waits on real backoff.
+"""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from s2_verification_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    builtin_rules,
+    parse_rule,
+)
+from s2_verification_tpu.obs.metrics import MetricsRegistry
+
+
+class _Receiver:
+    """Scripted webhook endpoint: ``script`` is the status code per
+    attempt (exhausted → 200).  Bodies of accepted (2xx) posts are kept."""
+
+    def __init__(self, script=()):
+        self.bodies = []
+        self.attempts = 0
+        script = list(script)
+        recv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 - stdlib handler name
+                recv.attempts += 1
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                code = script.pop(0) if script else 200
+                if 200 <= code < 300:
+                    recv.bodies.append(json.loads(body.decode("utf-8")))
+                self.send_response(code)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}/alert"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class _Recorder:
+    """FlightRecorder stand-in capturing alert records and dump markers."""
+
+    def __init__(self):
+        self.alerts = []
+        self.dumps = []
+
+    def record_alert(self, alert):
+        self.alerts.append(dict(alert))
+
+    def dump(self, reason, **fields):
+        self.dumps.append({"reason": reason, **fields})
+
+
+def _engine(url, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("sleep_fn", lambda s: None)
+    return AlertEngine(url, **kw)
+
+
+# -- rule grammar -----------------------------------------------------------
+
+
+def test_parse_rule_event():
+    r = parse_rule("slo_breach")
+    assert r.kind == "event" and r.event == "slo_breach"
+    assert r.severity == "page"
+
+
+def test_parse_rule_field_threshold():
+    r = parse_rule("done.wall_s>30")
+    assert r == AlertRule(
+        name="done.wall_s>30", kind="field", event="done", field="wall_s",
+        op=">", threshold=30.0, severity="warn",
+    )
+
+
+def test_parse_rule_metric_threshold_longest_op_wins():
+    r = parse_rule("metric:verifyd_job_errors_total>=5")
+    assert r.kind == "metric"
+    assert r.metric == "verifyd_job_errors_total"
+    assert r.op == ">=" and r.threshold == 5.0
+
+
+@pytest.mark.parametrize(
+    "spec",
+    ["", "  ", "done.>3", ".wall_s>3", "wall_s>", "metric:>5",
+     "metric:foo>bar", "no spaces allowed", "a.b.c>x"],
+)
+def test_parse_rule_rejects_nonsense(spec):
+    with pytest.raises(ValueError):
+        parse_rule(spec)
+
+
+def test_builtin_rules_page_on_breach_and_regression():
+    names = {r.name for r in builtin_rules()}
+    assert names == {"slo_breach", "perf_regression"}
+    assert all(r.severity == "page" for r in builtin_rules())
+
+
+# -- delivery ---------------------------------------------------------------
+
+
+def test_delivers_alertmanager_payload():
+    recv = _Receiver()
+    recorder = _Recorder()
+    eng = _engine(recv.url, recorder=recorder)
+    try:
+        eng.observe_event(
+            {"ev": "slo_breach", "t": 123.0, "reason": "burn", "shape": "4x2x8"}
+        )
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 1
+        payload = recv.bodies[0]
+        assert isinstance(payload, list) and len(payload) == 1
+        alert = payload[0]
+        assert alert["labels"]["alertname"] == "slo_breach"
+        assert alert["labels"]["service"] == "verifyd"
+        assert alert["labels"]["severity"] == "page"
+        assert alert["labels"]["shape"] == "4x2x8"
+        assert "T" in alert["startsAt"] and alert["startsAt"].endswith("Z")
+        assert "slo_breach" in alert["annotations"]["summary"]
+        detail = json.loads(alert["annotations"]["detail"])
+        assert detail["reason"] == "burn"
+        # flight ring got the alert record on the firing path
+        assert recorder.alerts == [
+            {"rule": "slo_breach", "event": "slo_breach", "severity": "page"}
+        ]
+        sent = eng.registry.get("verifyd_alerts_sent_total")
+        assert sum(sent.snapshot().values()) == 1
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_5xx_backs_off_then_succeeds():
+    recv = _Receiver(script=[503, 500])
+    sleeps = []
+    eng = _engine(recv.url, backoff_s=0.5, sleep_fn=sleeps.append)
+    try:
+        eng.observe_event({"ev": "slo_breach"})
+        assert eng.flush(timeout=10.0)
+        assert recv.attempts == 3  # 503, 500, 200
+        assert len(recv.bodies) == 1
+        # full jitter: each sleep within the exponential cap for its attempt
+        assert len(sleeps) == 2
+        assert 0.0 <= sleeps[0] <= 0.5
+        assert 0.0 <= sleeps[1] <= 1.0
+        sent = eng.registry.get("verifyd_alerts_sent_total")
+        failed = eng.registry.get("verifyd_alerts_failed_total")
+        assert sum(sent.snapshot().values()) == 1
+        assert sum(failed.snapshot().values()) == 0
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_permanent_failure_counts_and_dumps():
+    recv = _Receiver(script=[500, 500, 500])
+    recorder = _Recorder()
+    eng = _engine(recv.url, retries=2, recorder=recorder)
+    try:
+        eng.observe_event({"ev": "slo_breach"})
+        assert eng.flush(timeout=10.0)
+        assert recv.attempts == 3  # initial + 2 retries, all 500
+        assert recv.bodies == []
+        failed = eng.registry.get("verifyd_alerts_failed_total")
+        assert failed.value(rule="slo_breach") == 1
+        assert len(recorder.dumps) == 1
+        dump = recorder.dumps[0]
+        assert dump["reason"] == "alert_failed"
+        assert dump["rule"] == "slo_breach"
+        assert dump["attempts"] == 3
+        assert "500" in dump["error"]
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_definite_4xx_is_not_retried():
+    recv = _Receiver(script=[400, 200, 200])
+    eng = _engine(recv.url, retries=3)
+    try:
+        eng.observe_event({"ev": "slo_breach"})
+        assert eng.flush(timeout=10.0)
+        assert recv.attempts == 1  # 400 is definite: no retry
+        failed = eng.registry.get("verifyd_alerts_failed_total")
+        assert sum(failed.snapshot().values()) == 1
+    finally:
+        eng.close()
+        recv.close()
+
+
+# -- dedup / re-arm ---------------------------------------------------------
+
+
+def test_dedup_window_suppresses_then_rearms():
+    recv = _Receiver()
+    clock = [1000.0]
+    eng = _engine(recv.url, dedup_s=300.0, time_fn=lambda: clock[0])
+    try:
+        eng.observe_event({"ev": "slo_breach"})
+        clock[0] += 10.0
+        eng.observe_event({"ev": "slo_breach"})  # inside the window
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 1
+        snap = eng.snapshot()
+        assert snap["rules"]["slo_breach"]["fired"] == 1
+        assert snap["rules"]["slo_breach"]["suppressed"] == 1
+        sup = eng.registry.get("verifyd_alerts_suppressed_total")
+        assert sup.value(rule="slo_breach") == 1
+
+        clock[0] += 300.0  # window over: delivery resumes
+        eng.observe_event({"ev": "slo_breach"})
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 2
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_field_rule_edge_triggered_rearm():
+    recv = _Receiver()
+    clock = [0.0]
+    eng = _engine(
+        recv.url,
+        rules=[parse_rule("done.wall_s>1")],
+        dedup_s=0.0,
+        time_fn=lambda: clock[0],
+    )
+    try:
+        for wall in (2.0, 3.0, 5.0):  # one crossing, held above
+            clock[0] += 1.0
+            eng.observe_event({"ev": "done", "wall_s": wall})
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 1  # fired on the edge only
+
+        clock[0] += 1.0
+        eng.observe_event({"ev": "done", "wall_s": 0.5})  # back in band
+        clock[0] += 1.0
+        eng.observe_event({"ev": "done", "wall_s": 2.0})  # second crossing
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 2
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_metric_rule_thresholds_registry_value():
+    recv = _Receiver()
+    registry = MetricsRegistry()
+    errors = registry.counter(
+        "job_errors_total", "test counter", labelnames=("kind",)
+    )
+    eng = _engine(
+        recv.url,
+        rules=[parse_rule("metric:job_errors_total>=3")],
+        registry=registry,
+        dedup_s=0.0,
+    )
+    try:
+        errors.inc(kind="a")
+        eng.observe_event({"ev": "done"})  # 1 < 3: quiet
+        errors.inc(kind="a")
+        errors.inc(kind="b")  # labeled sum = 3
+        eng.observe_event({"ev": "done"})
+        eng.observe_event({"ev": "done"})  # still over: edge-triggered, quiet
+        assert eng.flush(timeout=10.0)
+        assert len(recv.bodies) == 1
+        assert recv.bodies[0][0]["labels"]["severity"] == "warn"
+    finally:
+        eng.close()
+        recv.close()
+
+
+def test_unmatched_events_deliver_nothing():
+    recv = _Receiver()
+    eng = _engine(recv.url)
+    try:
+        eng.observe_event({"ev": "done", "wall_s": 0.1})
+        eng.observe_event({"ev": "accept"})
+        eng.observe_event({"no_event_key": True})
+        assert eng.flush(timeout=5.0)
+        assert recv.bodies == [] and recv.attempts == 0
+    finally:
+        eng.close()
+        recv.close()

@@ -1,0 +1,238 @@
+"""Profile archive: durable round-trips, query semantics, replay parity.
+
+The archive is the daemon's flight-data recorder for *performance*: one
+compact record per finished job plus the history corpus keyed by
+fingerprint, both over CRC-checked segment logs.  These tests cover the
+unit fold (observe_event → record, lease-wait correlation), the cold
+readers, the filter algebra, and the end-to-end contract the ISSUE
+names: after a daemon dies, ``profiles`` still lists its jobs and
+``scripts/workload_replay.py`` re-runs them with verdict parity.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from s2_verification_tpu.obs.archive import (
+    ProfileArchive,
+    filter_records,
+    read_archive,
+    read_corpus,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _done(job, **kw):
+    ev = {
+        "ev": "done",
+        "t": 100.0 + job,
+        "job": job,
+        "client": "t",
+        "shape": "4x2x8",
+        "backend": "native",
+        "verdict": 0,
+        "wall_s": 0.01 * job,
+        "queue_wait_s": 0.001,
+        "ops": 8,
+        "fingerprint": f"v1:{job:016x}:8",
+        "profile": {"layers": 3},
+    }
+    ev.update(kw)
+    return ev
+
+
+# -- unit: the fold and the cold readers ------------------------------------
+
+
+def test_archive_round_trip(tmp_path):
+    d = str(tmp_path / "profiles")
+    a = ProfileArchive(d)
+    a.observe_event({"ev": "lease_grant", "job": 2, "wait_s": 0.25})
+    a.observe_event(_done(1))
+    a.observe_event(_done(2, verdict=1, backend="device-mesh[4]"))
+    a.observe_event({"ev": "accept", "job": 3})  # not a done: ignored
+    assert a.add_history("v1:%016x:8" % 1, "line1\n")
+    assert not a.add_history("v1:%016x:8" % 1, "line1\n")  # dedup by fp
+    assert len(a) == 2
+    a.close()
+
+    b = ProfileArchive(d)
+    assert len(b) == 2
+    recs = b.query()
+    assert [r["job"] for r in recs] == [1, 2]
+    assert recs[0]["fp"] == "v1:%016x:8" % 1
+    assert recs[0]["profile"] == {"layers": 3}
+    # lease_grant wait correlated onto job 2's record only
+    assert "lease_wait_s" not in recs[0]
+    assert recs[1]["lease_wait_s"] == 0.25
+    assert b.history("v1:%016x:8" % 1) == "line1\n"
+    b.close()
+
+
+def test_cold_readers_tolerate_missing_state(tmp_path):
+    assert read_archive(str(tmp_path)) == []
+    assert read_corpus(str(tmp_path)) == {}
+
+
+def test_cold_readers_see_unclosed_appends(tmp_path):
+    state = str(tmp_path)
+    a = ProfileArchive(os.path.join(state, "profiles"))
+    a.observe_event(_done(1))
+    a.add_history("v1:%016x:8" % 1, "line1\n")
+    a.close()
+    recs = read_archive(state)
+    assert len(recs) == 1 and recs[0]["job"] == 1
+    assert read_corpus(state) == {"v1:%016x:8" % 1: "line1\n"}
+
+
+def test_filter_records_algebra():
+    recs = [
+        _done(1),
+        _done(2, shape="8x4x16", wall_s=5.0),
+        _done(3, verdict=2, backend="device-mesh[2]", client="u"),
+        _done(4, t=500.0),
+    ]
+    for r in recs:
+        r["fp"] = r.pop("fingerprint")
+    assert [r["job"] for r in filter_records(recs, shape="8x4x16")] == [2]
+    assert [r["job"] for r in filter_records(recs, backend="device")] == [3]
+    assert [r["job"] for r in filter_records(recs, verdict=2)] == [3]
+    assert [r["job"] for r in filter_records(recs, client="u")] == [3]
+    assert [r["job"] for r in filter_records(recs, since=200.0)] == [4]
+    # slowest ranks by wall desc and wins over limit
+    slow = filter_records(recs, slowest=2, limit=1)
+    assert [r["job"] for r in slow] == [2, 4]
+    # limit keeps the newest N in recorded order
+    assert [r["job"] for r in filter_records(recs, limit=2)] == [3, 4]
+    # returned records are copies, not aliases
+    filter_records(recs)[0]["job"] = 999
+    assert recs[0]["job"] == 1
+
+
+# -- end to end: archive a workload, kill the daemon, query + replay --------
+
+
+@pytest.fixture(scope="module")
+def archived_state(tmp_path_factory):
+    """A state dir left behind by a daemon that verified three histories."""
+    from s2_verification_tpu.collector.collect import (
+        CollectConfig,
+        collect_history,
+    )
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+    from s2_verification_tpu.utils import events as ev
+
+    tmp = tmp_path_factory.mktemp("archive-e2e")
+    state = str(tmp / "state")
+    sock = str(tmp / "verifyd.sock")
+    texts = []
+    for seed in range(3):
+        hist = collect_history(
+            CollectConfig(
+                num_concurrent_clients=2, num_ops_per_client=8, seed=seed
+            )
+        )
+        buf = io.StringIO()
+        ev.write_history(hist, buf)
+        texts.append(buf.getvalue())
+
+    cfg = VerifydConfig(
+        socket_path=sock,
+        state_dir=state,
+        device="off",
+        no_viz=True,
+        stats_log=None,
+        out_dir=str(tmp / "viz"),
+    )
+    verdicts = []
+    with Verifyd(cfg):
+        client = VerifydClient(sock)
+        for text in texts:
+            reply = client.submit(text, client="e2e")
+            verdicts.append(reply["verdict"])
+    return {"state": state, "sock": sock, "cfg": cfg, "verdicts": verdicts}
+
+
+def test_profiles_survive_restart(archived_state):
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd
+
+    # Cold: straight off the segment logs, no daemon.
+    cold = read_archive(archived_state["state"])
+    assert len(cold) == 3
+    corpus = read_corpus(archived_state["state"])
+    assert set(corpus) == {r["fp"] for r in cold}
+    for rec in cold:
+        assert rec["shape"] and rec["wall_s"] is not None
+        assert rec.get("profile") is None or isinstance(rec["profile"], dict)
+
+    # Warm: a restarted daemon replays the archive and answers the op.
+    with Verifyd(archived_state["cfg"]):
+        client = VerifydClient(archived_state["sock"])
+        reply = client.profiles()
+        assert reply["total"] == 3
+        assert len(reply["records"]) == 3
+        one = client.profiles(slowest=1)
+        assert len(one["records"]) == 1
+        assert one["records"][0]["wall_s"] == max(r["wall_s"] for r in cold)
+
+
+def test_profiles_op_without_state_dir_is_decode_error(tmp_path):
+    from s2_verification_tpu.service.client import VerifydClient, VerifydError
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+
+    sock = str(tmp_path / "verifyd.sock")
+    cfg = VerifydConfig(
+        socket_path=sock, device="off", no_viz=True, stats_log=None
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(sock)
+        with pytest.raises(VerifydError) as ei:
+            client.profiles()
+        assert ei.value.cls == "DecodeError"
+
+
+@pytest.mark.slow
+def test_workload_replay_parity(archived_state):
+    """scripts/workload_replay.py re-runs the archived jobs against a
+    fresh daemon and exits 0 with zero verdict mismatches."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "workload_replay.py"),
+            "--state-dir",
+            archived_state["state"],
+            "--concurrency",
+            "2",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    line = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "replay_jobs_per_sec"
+    assert line["jobs"] == 3
+    assert line["mismatches"] == 0
+    assert line["skipped"] == 0
+    assert line["recorded_avg_wall_s"] > 0
+
+
+def test_archive_in_stats_snapshot(archived_state):
+    from s2_verification_tpu.service.client import VerifydClient
+    from s2_verification_tpu.service.daemon import Verifyd
+
+    with Verifyd(archived_state["cfg"]):
+        client = VerifydClient(archived_state["sock"])
+        snap = client.stats()
+        assert snap["archive"]["records"] == 3
+        assert snap["archive"]["histories"] == 3

@@ -1,0 +1,150 @@
+"""PerfSentinel: EWMA fold math, consecutive filter, re-arm, wiring.
+
+The fold is unit-tested directly (observe), then through the event
+stream (observe_event → ServiceStats re-emit → counters → AlertEngine
+routing) — the full path a live regression takes from a slow job to a
+delivered page.
+"""
+
+from s2_verification_tpu.obs.metrics import MetricsRegistry
+from s2_verification_tpu.obs.sentinel import (
+    PerfSentinel,
+    SentinelConfig,
+    ewma_drift,
+)
+
+FAST = SentinelConfig(min_samples=3, consecutive=2, floor_s=0.001)
+
+
+def _warm(s, shape="4x2x8", n=5, wall=0.1):
+    for _ in range(n):
+        assert s.observe(shape, wall) is None
+
+
+def test_ewma_drift_predicate():
+    assert ewma_drift(1.76, 1.0, 0.75)
+    assert not ewma_drift(1.75, 1.0, 0.75)
+    assert not ewma_drift(0.5, 1.0, 0.75)
+
+
+def test_cold_start_never_fires():
+    s = PerfSentinel(SentinelConfig(min_samples=10, consecutive=1))
+    for _ in range(10):
+        assert s.observe("shape", 5.0) is None
+    # 11th sample is judged, but sits on its own baseline: still quiet
+    assert s.observe("shape", 5.0) is None
+
+
+def test_consecutive_filter_and_report_fields():
+    s = PerfSentinel(FAST)
+    _warm(s, n=5)
+    assert s.observe("4x2x8", 1.0) is None  # streak 1 of 2
+    report = s.observe("4x2x8", 1.0)  # streak 2: fires
+    assert report is not None
+    assert report["shape"] == "4x2x8"
+    assert report["wall_s"] == 1.0
+    # baseline folded once at alpha/8 by the first slow sample:
+    # 0.1 + (0.25/8)*(1.0-0.1) ≈ 0.128
+    assert 0.09 < report["baseline_wall_s"] < 0.15
+    assert report["ratio"] > 6
+    assert report["streak"] == 2
+    assert report["samples"] == 7
+
+
+def test_single_spike_is_not_a_regression():
+    s = PerfSentinel(FAST)
+    _warm(s, n=5)
+    assert s.observe("4x2x8", 1.0) is None  # one blip
+    assert s.observe("4x2x8", 0.1) is None  # back in band: streak reset
+    assert s.observe("4x2x8", 1.0) is None  # streak restarts at 1
+    assert s.observe("4x2x8", 1.0) is not None
+
+
+def test_latched_until_recovery_then_rearms():
+    s = PerfSentinel(FAST)
+    _warm(s, n=5)
+    s.observe("4x2x8", 1.0)
+    assert s.observe("4x2x8", 1.0) is not None  # fires
+    assert s.observe("4x2x8", 1.0) is None  # latched: no page storm
+    assert s.observe("4x2x8", 0.1) is None  # recovery re-arms
+    s.observe("4x2x8", 1.0)
+    assert s.observe("4x2x8", 1.0) is not None  # second regression pages
+    assert s.snapshot()["shapes"]["4x2x8"]["regressions"] == 2
+
+
+def test_spike_barely_moves_baseline():
+    s = PerfSentinel(FAST)
+    _warm(s, n=5)
+    before = s.snapshot()["shapes"]["4x2x8"]["baseline_wall_s"]
+    s.observe("4x2x8", 10.0)  # out of band: folds at alpha/8
+    after = s.snapshot()["shapes"]["4x2x8"]["baseline_wall_s"]
+    assert after < before + (10.0 - before) * FAST.alpha / 4
+    # an in-band sample folds at full alpha by comparison
+    s2 = PerfSentinel(FAST)
+    _warm(s2, n=5)
+    s2.observe("4x2x8", 0.15)
+    moved = s2.snapshot()["shapes"]["4x2x8"]["baseline_wall_s"]
+    assert moved > before + (0.15 - before) * FAST.alpha * 0.9
+
+
+def test_floor_guards_noise_shapes():
+    s = PerfSentinel(SentinelConfig(min_samples=2, consecutive=1, floor_s=0.005))
+    for _ in range(5):
+        s.observe("tiny", 0.0001)
+    # 30x drift but still under the floor: never judged
+    assert s.observe("tiny", 0.003) is None
+
+
+def test_shapes_are_independent():
+    s = PerfSentinel(FAST)
+    _warm(s, shape="a", n=5, wall=0.1)
+    _warm(s, shape="b", n=5, wall=2.0)
+    s.observe("a", 1.0)
+    assert s.observe("a", 1.0) is not None  # 10x on shape a
+    assert s.observe("b", 2.0) is None  # shape b undisturbed
+
+
+def test_metrics_and_snapshot():
+    reg = MetricsRegistry()
+    s = PerfSentinel(FAST, registry=reg)
+    _warm(s, n=5)
+    s.observe("4x2x8", 1.0)
+    s.observe("4x2x8", 1.0)
+    assert reg.get("verifyd_perf_regressions_total").value(shape="4x2x8") == 1
+    assert reg.get("verifyd_perf_baseline_wall_seconds").value(shape="4x2x8") > 0
+    snap = s.snapshot()
+    assert snap["regressions"] == 1
+    assert snap["config"]["band"] == FAST.band
+    st = snap["shapes"]["4x2x8"]
+    assert st["fired"] and st["streak"] == 2 and st["samples"] == 7
+
+
+def test_event_stream_routes_to_alert_engine():
+    """done events → sentinel → perf_regression re-emit → counter + alert."""
+    from s2_verification_tpu.obs.alerts import AlertEngine
+    from s2_verification_tpu.service.stats import ServiceStats
+
+    reg = MetricsRegistry()
+    fired = []
+
+    class _CaptureEngine(AlertEngine):
+        def _deliver(self, alert):
+            fired.append(alert["rule"].name)
+
+    eng = _CaptureEngine("http://127.0.0.1:1/unused", registry=reg)
+    sentinel = PerfSentinel(FAST, registry=reg)
+    stats = ServiceStats(
+        sink=None, registry=reg, sentinel=sentinel, alerts=eng
+    )
+    try:
+        for _ in range(5):
+            stats.emit("done", shape="4x2x8", backend="native", wall_s=0.1)
+        stats.emit("done", shape="4x2x8", backend="native", wall_s=1.0)
+        stats.emit("done", shape="4x2x8", backend="native", wall_s=1.0)
+        assert eng.flush(timeout=10.0)
+        assert fired == ["perf_regression"]
+        snap = stats.snapshot()
+        assert snap["perf_regressions"] == 1
+        assert snap["sentinel"]["regressions"] == 1
+    finally:
+        eng.close()
