@@ -83,6 +83,7 @@ from ..models.encode import (
     round_pow2,
 )
 from ..models.stream import StreamState
+from ..obs.introspect import observe_jit
 from ..utils.cache import enable_persistent_cache
 from .entries import History
 from .frontier import FrontierStats
@@ -1473,6 +1474,18 @@ def _regrow_device(fr: Frontier, *, capacity: int) -> Frontier:
         tok=g1(fr.tok),
         valid=g1(fr.valid),
     )
+
+
+# JIT observability (obs/introspect.py): every jitted entry point above
+# reports compiles / retraces / executable-cache hits to the process
+# introspector, keyed by abstract shape signature and attributed to the
+# serving job context when one is set.  The wrapper is a dict probe on
+# the hit path — nothing here touches the compiled computation.
+run_search = observe_jit("run_search")(run_search)
+_accept_set_device = observe_jit("accept_set")(_accept_set_device)
+_accept_sweep_device = observe_jit("accept_sweep")(_accept_sweep_device)
+_compact_rows_device = observe_jit("compact_rows")(_compact_rows_device)
+_regrow_device = observe_jit("regrow")(_regrow_device)
 
 
 _WITNESS_CHUNK = 512

@@ -568,6 +568,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         alert_dedup_s=args.alert_dedup,
         sentinel_band=args.sentinel_band,
         sentinel_min_samples=args.sentinel_min_samples,
+        resource_sample_s=args.resource_sample,
+        retrace_storm_threshold=args.retrace_storm,
+        dashboard_sample_s=args.dashboard_sample,
     )
     daemon = Verifyd(cfg)
 
@@ -653,6 +656,20 @@ def _profile_filters(args: argparse.Namespace) -> dict:
     }
 
 
+def _csv_cell(value) -> str:
+    """One RFC-4180-safe cell: containers (the ``shards`` summary, op
+    breakdowns) become JSON — their Python reprs hold commas and quotes
+    that round-trip badly — and everything else is stringified for the
+    writer to quote as needed."""
+    import json as _json
+
+    if isinstance(value, (dict, list, tuple)):
+        return _json.dumps(value, sort_keys=True, default=str)
+    if value is None:
+        return ""
+    return str(value)
+
+
 def _export_profiles(records: list[dict], path, fmt: str) -> None:
     import json as _json
 
@@ -663,10 +680,14 @@ def _export_profiles(records: list[dict], path, fmt: str) -> None:
         return
     import csv as _csv
 
-    w = _csv.writer(path)
+    # Explicit dialect: QUOTE_MINIMAL wraps any cell holding a comma,
+    # quote, or newline (doubling embedded quotes per RFC 4180), and the
+    # fixed "\n" terminator keeps stdout export ("-", opened without
+    # newline="") from emitting \r\r\n on platforms that translate.
+    w = _csv.writer(path, quoting=_csv.QUOTE_MINIMAL, lineterminator="\n")
     w.writerow(_PROFILE_COLUMNS)
     for rec in records:
-        w.writerow([rec.get(col, "") for col in _PROFILE_COLUMNS])
+        w.writerow([_csv_cell(rec.get(col, "")) for col in _PROFILE_COLUMNS])
 
 
 def _cmd_profiles(args: argparse.Namespace) -> int:
@@ -802,6 +823,117 @@ def _cmd_trace(args: argparse.Namespace) -> int:
             args.out,
         )
     return 0
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values: list[float], width: int = 32) -> str:
+    """Unicode sparkline over the last ``width`` values (terminal `top`
+    aesthetics; empty history renders as spaces, flat history as ▁s)."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return " " * width
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    out = []
+    for v in vals:
+        idx = 0 if span <= 0 else int((v - lo) / span * (len(_SPARK_BLOCKS) - 1))
+        out.append(_SPARK_BLOCKS[idx])
+    return "".join(out).rjust(width)
+
+
+def _cmd_dash(args: argparse.Namespace) -> int:
+    """`verifyd top`: poll the stats op and render terminal sparklines."""
+    from .service.client import (
+        VerifydClient,
+        VerifydError,
+        VerifydUnavailable,
+    )
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+
+    hist: dict[str, list[float]] = {
+        "throughput": [],
+        "queue": [],
+        "active": [],
+        "rss_mb": [],
+        "compiles": [],
+    }
+    prev_completed: float | None = None
+    prev_compiles: float | None = None
+    prev_t: float | None = None
+    n = 0
+    try:
+        while True:
+            try:
+                snap = client.stats()
+            except VerifydUnavailable as e:
+                log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+                return EXIT_UNAVAILABLE
+            except VerifydError as e:
+                log.error("stats refused: %s", e)
+                return EXIT_PROTOCOL
+            except (OSError, TimeoutError) as e:
+                log.error("cannot reach verifyd on %s: %s", args.socket, e)
+                return EXIT_UNAVAILABLE
+            now = time.time()
+            completed = float(snap.get("completed", 0))
+            intro = snap.get("introspection") or {}
+            jit = intro.get("jit") or {}
+            compiles = float(sum((jit.get("compiles") or {}).values()))
+            if prev_t is not None and now > prev_t:
+                hist["throughput"].append(
+                    max(0.0, completed - (prev_completed or 0.0)) / (now - prev_t)
+                )
+                hist["compiles"].append(max(0.0, compiles - (prev_compiles or 0.0)))
+            prev_completed, prev_compiles, prev_t = completed, compiles, now
+            hist["queue"].append(float(snap.get("queue_depth_now", 0)))
+            hist["active"].append(float(snap.get("active", 0)))
+            res = (intro.get("resources") or {}).get("last") or {}
+            hist["rss_mb"].append(float(res.get("rss_bytes", 0) or 0) / (1 << 20))
+            for k in hist:
+                hist[k] = hist[k][-args.width :]
+
+            lines = [
+                "verifyd dash  socket=%s  uptime=%.0fs  completed=%d  "
+                "cache_hits=%d  errors=%d"
+                % (
+                    args.socket,
+                    float(snap.get("uptime_s", 0.0)),
+                    int(snap.get("completed", 0)),
+                    int(snap.get("cache_hits", 0)),
+                    int(snap.get("errors", 0)),
+                )
+            ]
+            rows = (
+                ("throughput", "jobs/s", hist["throughput"]),
+                ("queue", "depth", hist["queue"]),
+                ("active", "jobs", hist["active"]),
+                ("rss", "MiB", hist["rss_mb"]),
+                ("compiles", "per tick", hist["compiles"]),
+            )
+            for name, unit, series in rows:
+                cur = series[-1] if series else 0.0
+                lines.append(
+                    "  %-10s %s  %10.2f %s"
+                    % (name, _spark(series, args.width), cur, unit)
+                )
+            storms = int(snap.get("retrace_storms", 0))
+            if storms:
+                lines.append("  !! retrace storms latched: %d" % storms)
+            print("\n".join(lines), flush=True)
+            n += 1
+            if args.iterations and n >= args.iterations:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cmd_submit(args: argparse.Namespace) -> int:
@@ -1196,6 +1328,33 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="N",
         help="jobs per shape before the sentinel judges drift (default 8)",
     )
+    s.add_argument(
+        "--resource-sample",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="resource-telemetry sampling interval: host RSS, CPU, fds, "
+        "threads, GC pauses into verifyd_resource_* gauges and the "
+        "flight recorder (default 1.0; <=0 disables the sampler)",
+    )
+    s.add_argument(
+        "--retrace-storm",
+        type=int,
+        default=5,
+        metavar="N",
+        help="emit a latched retrace_storm event when one jit site "
+        "recompiles a shape bucket more than N times (default 5; "
+        "0 disables)",
+    )
+    s.add_argument(
+        "--dashboard-sample",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="/dashboard sparkline sampling interval on the metrics "
+        "listener (needs --metrics-port; default 2.0; <=0 disables "
+        "the dashboard)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
 
     d = sub.add_parser(
@@ -1323,6 +1482,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="output path for the trace JSON ('-' = stdout, the default)",
     )
     t.set_defaults(fn=_cmd_trace)
+
+    da = sub.add_parser(
+        "dash",
+        help="live terminal dashboard over a running verifyd: sparkline "
+        "history of throughput, queue depth, active jobs, RSS, and JIT "
+        "compile activity from the stats op (the HTML twin lives at "
+        "/dashboard on --metrics-port)",
+    )
+    da.add_argument(
+        "-socket",
+        "--socket",
+        required=True,
+        help="the daemon's unix-socket path, or HOST:PORT for the "
+        "authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET)",
+    )
+    da.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    da.add_argument(
+        "--interval",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="seconds between polls (default 2.0)",
+    )
+    da.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        metavar="N",
+        help="stop after N frames (default 0 = run until interrupted)",
+    )
+    da.add_argument(
+        "--width",
+        type=int,
+        default=32,
+        metavar="COLS",
+        help="sparkline width in characters (default 32)",
+    )
+    da.set_defaults(fn=_cmd_dash)
 
     u = sub.add_parser("submit", help="submit one history to a running verifyd")
     u.add_argument(

@@ -26,6 +26,15 @@ engines and verifyd hot paths:
                 corpus over seglog: the replayable recorded-traffic set.
 - ``sentinel``— per-shape EWMA wall-time baselines emitting
                 ``perf_regression`` events when drift exceeds the band.
+- ``introspect`` — runtime introspection: the JIT-compile tracker
+                (compiles / retraces / cache hits per abstract shape,
+                ``retrace_storm`` events) wrapped around the device jit
+                sites, plus the ResourceSampler (RSS / CPU / fds /
+                threads / GC pauses / device memory) feeding gauges and
+                the flight recorder.
+- ``dashboard`` — live self-contained HTML dashboard (``/dashboard`` on
+                the obs httpd): sparkline history sampled straight from
+                the metric families.
 
 Everything here is stdlib-only by design: the daemon must stay deployable
 on a bare TPU host image with no pip access.
@@ -34,8 +43,17 @@ on a bare TPU host image with no pip access.
 from .alerts import AlertEngine, AlertRule, builtin_rules, parse_rule
 from .archive import ProfileArchive, filter_records, read_archive, read_corpus
 from .context import new_trace_id, valid_trace_id
+from .dashboard import Dashboard
 from .flight import FlightRecorder, postmortem, read_flight, render_postmortem
 from .health import SLOConfig, SLOHealth
+from .introspect import (
+    INTROSPECTOR,
+    JitIntrospector,
+    ResourceSampler,
+    get_job_context,
+    job_context,
+    observe_jit,
+)
 from .log import StructuredLogger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .sentinel import PerfSentinel, SentinelConfig
@@ -45,12 +63,16 @@ __all__ = [
     "AlertEngine",
     "AlertRule",
     "Counter",
+    "Dashboard",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "INTROSPECTOR",
+    "JitIntrospector",
     "MetricsRegistry",
     "PerfSentinel",
     "ProfileArchive",
+    "ResourceSampler",
     "SLOConfig",
     "SLOHealth",
     "SentinelConfig",
@@ -58,7 +80,10 @@ __all__ = [
     "Tracer",
     "builtin_rules",
     "filter_records",
+    "get_job_context",
+    "job_context",
     "new_trace_id",
+    "observe_jit",
     "parse_rule",
     "postmortem",
     "read_archive",

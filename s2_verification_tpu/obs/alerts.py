@@ -142,10 +142,11 @@ def parse_rule(spec: str) -> AlertRule:
 
 
 def builtin_rules() -> Tuple[AlertRule, ...]:
-    """The two signals every deployment should page on."""
+    """The three signals every deployment should page on."""
     return (
         AlertRule(name="slo_breach", kind="event", event="slo_breach"),
         AlertRule(name="perf_regression", kind="event", event="perf_regression"),
+        AlertRule(name="retrace_storm", kind="event", event="retrace_storm"),
     )
 
 

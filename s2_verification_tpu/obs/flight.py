@@ -13,9 +13,13 @@ storage discipline as the verdict cache) under
   abandoned deliveries additionally leave an ``alert_failed`` dump
   marker),
 - explicit **dump** records on SIGTERM / daemon close / SLO breach,
-  carrying a full SLO snapshot at that instant.
+  carrying a full SLO snapshot at that instant,
+- periodic resource samples from the ResourceSampler (``{"k": "res"}``
+  records: RSS, CPU seconds, fds, threads, GC pauses), so the doctor can
+  show the resource timeline *before* a death — an OOM kill reads as a
+  climbing RSS line ending mid-flight.
 
-Each record is one JSON object ``{"k": "ev"|"span"|"alert"|"dump",
+Each record is one JSON object ``{"k": "ev"|"span"|"alert"|"dump"|"res",
 "t": wall, ...}``.  Because every append is flushed, the tail survives SIGKILL up
 to the last OS write — exactly the property the doctor needs.
 
@@ -87,6 +91,10 @@ class FlightRecorder:
         arrive separately as ``alert_failed`` dump markers."""
         self._append({"k": "alert", "t": round(time.time(), 6), **alert})
 
+    def record_resource(self, sample: Dict[str, Any]) -> None:
+        """Absorb one ResourceSampler sample (already has ``t``)."""
+        self._append({"k": "res", **sample})
+
     def dump(self, reason: str, **extra: Any) -> None:
         """Write a marker record (shutdown / sigterm / slo_breach) with
         whatever context the caller attaches (usually ``slo=snapshot``)."""
@@ -156,6 +164,7 @@ def postmortem(
     spans = [r for r in records if r.get("k") == "span"]
     dumps = [r for r in records if r.get("k") == "dump"]
     alerts = [r for r in records if r.get("k") == "alert"]
+    resources = [r for r in records if r.get("k") == "res"]
 
     # Open leases: grants never matched by a release/timeout of the same job.
     open_leases: Dict[Any, Dict[str, Any]] = {}
@@ -214,6 +223,10 @@ def postmortem(
         "open_leases": list(open_leases.values()),
         "slowest_spans": slowest,
         "slo_at_death": slo_at_death,
+        # Resource timeline before death: keep the tail — the interesting
+        # part of an OOM story is the last few minutes, not the first.
+        "resources": resources[-tail:],
+        "resource_samples": len(resources),
     }
 
 
@@ -360,6 +373,25 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                 )
             )
 
+    if pm.get("resources"):
+        add("")
+        add(
+            "-- resource timeline (last %d of %d samples) --"
+            % (len(pm["resources"]), pm.get("resource_samples", len(pm["resources"])))
+        )
+        for r in pm["resources"]:
+            add(
+                "  %s  rss=%7.1fMiB cpu=%8.1fs fds=%-4s threads=%-3s gc=%.3fs"
+                % (
+                    _fmt_t(r.get("t")),
+                    float(r.get("rss_bytes", 0) or 0) / (1 << 20),
+                    float(r.get("cpu_s", 0.0) or 0.0),
+                    r.get("fds", "?"),
+                    r.get("threads", "?"),
+                    float(r.get("gc_pause_s", 0.0) or 0.0),
+                )
+            )
+
     if pm["tail"]:
         add("")
         add("-- flight tail (last %d of %d) --" % (min(tail, len(pm["tail"])), pm["records"]))
@@ -394,6 +426,15 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                         _fmt_t(rec.get("t")),
                         rec.get("event", "?"),
                         rec.get("rule"),
+                    )
+                )
+            elif kind == "res":
+                add(
+                    "  %s res  rss=%.1fMiB threads=%s"
+                    % (
+                        _fmt_t(rec.get("t")),
+                        float(rec.get("rss_bytes", 0) or 0) / (1 << 20),
+                        rec.get("threads", "?"),
                     )
                 )
             else:

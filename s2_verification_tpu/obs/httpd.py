@@ -1,4 +1,4 @@
-"""Stdlib-only HTTP listener: /metrics, /healthz, /slo.
+"""Stdlib-only HTTP listener: /metrics, /healthz, /slo, /dashboard.
 
 One ThreadingHTTPServer on a daemon thread per daemon process.  Port 0
 binds an ephemeral port (the bound port is readable via ``.port`` — used
@@ -8,7 +8,10 @@ Endpoints:
 
 - ``GET /metrics`` — Prometheus text exposition.  When a health engine
   is attached, its gauges are refreshed *before* rendering so a scrape
-  never sees stale SLO numbers.
+  never sees stale SLO numbers.  Clients that negotiate
+  ``Accept: application/openmetrics-text`` get the OpenMetrics
+  exposition instead — same families, plus histogram exemplars
+  (bucket → ``trace_id``) and the ``# EOF`` terminator.
 - ``GET /healthz`` — a *real* health check: 200 with ``{"status":"ok"}``
   when within SLO, **503** with ``{"status":"degraded","reasons":[…]}``
   when a burn threshold or latency target is blown.  Load balancers key
@@ -18,6 +21,9 @@ Endpoints:
   rates, breach history) as JSON.
 - ``GET /sentinel`` — the perf-regression sentinel's per-shape EWMA
   baselines and trip counts as JSON (404 without a sentinel).
+- ``GET /dashboard`` — a self-contained zero-dependency HTML page with
+  server-side SVG sparklines over the retained scrape ring (404 without
+  a dashboard); ``GET /dashboard.json`` is the raw series feed.
 """
 
 from __future__ import annotations
@@ -27,9 +33,10 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import TYPE_CHECKING, Optional
 
-from .metrics import MetricsRegistry
+from .metrics import OPENMETRICS_CONTENT_TYPE, MetricsRegistry
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (health ← metrics)
+    from .dashboard import Dashboard
     from .health import SLOHealth
     from .sentinel import PerfSentinel
 
@@ -37,6 +44,7 @@ __all__ = ["MetricsServer", "CONTENT_TYPE"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _JSON_TYPE = "application/json; charset=utf-8"
+_HTML_TYPE = "text/html; charset=utf-8"
 
 
 class MetricsServer:
@@ -50,10 +58,12 @@ class MetricsServer:
         *,
         health: "Optional[SLOHealth]" = None,
         sentinel: "Optional[PerfSentinel]" = None,
+        dashboard: "Optional[Dashboard]" = None,
     ) -> None:
         self.registry = registry
         self.health = health
         self.sentinel = sentinel
+        self.dashboard = dashboard
 
         server = self
 
@@ -70,9 +80,19 @@ class MetricsServer:
                 if path == "/metrics":
                     if server.health is not None:
                         server.health.refresh()
-                    self._reply(
-                        200, server.registry.render().encode("utf-8"), CONTENT_TYPE
-                    )
+                    accept = self.headers.get("Accept", "") or ""
+                    if "application/openmetrics-text" in accept:
+                        self._reply(
+                            200,
+                            server.registry.render_openmetrics().encode("utf-8"),
+                            OPENMETRICS_CONTENT_TYPE,
+                        )
+                    else:
+                        self._reply(
+                            200,
+                            server.registry.render().encode("utf-8"),
+                            CONTENT_TYPE,
+                        )
                 elif path == "/healthz":
                     if server.health is None:
                         self._reply(200, b"ok\n", "text/plain; charset=utf-8")
@@ -95,6 +115,18 @@ class MetricsServer:
                     self._reply(
                         200,
                         (json.dumps(snap, sort_keys=True) + "\n").encode("utf-8"),
+                        _JSON_TYPE,
+                    )
+                elif path == "/dashboard" and server.dashboard is not None:
+                    self._reply(
+                        200,
+                        server.dashboard.render_html().encode("utf-8"),
+                        _HTML_TYPE,
+                    )
+                elif path == "/dashboard.json" and server.dashboard is not None:
+                    self._reply(
+                        200,
+                        server.dashboard.render_json().encode("utf-8"),
                         _JSON_TYPE,
                     )
                 else:

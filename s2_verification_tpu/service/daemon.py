@@ -39,9 +39,11 @@ from ..checker.entries import prepare
 from ..obs.alerts import AlertEngine, builtin_rules, parse_rule
 from ..obs.archive import ARCHIVE_SUBDIR, ProfileArchive
 from ..obs.context import TRACE_FIELD, new_trace_id, parse_trace_frame
+from ..obs.dashboard import Dashboard
 from ..obs.flight import FLIGHT_SUBDIR, FlightRecorder
 from ..obs.health import SLOConfig, SLOHealth
 from ..obs.httpd import MetricsServer
+from ..obs.introspect import INTROSPECTOR, ResourceSampler
 from ..obs.log import StructuredLogger
 from ..obs.metrics import MetricsRegistry
 from ..obs.sentinel import PerfSentinel, SentinelConfig
@@ -152,6 +154,19 @@ class VerifydConfig:
     sentinel_band: float = 0.75
     #: sentinel cold-start guard: per-shape jobs folded before judging
     sentinel_min_samples: int = 8
+    #: resource-telemetry sampling interval (RSS, CPU, fds, threads, GC
+    #: pauses, device memory → gauges + flight ring); <= 0 disables
+    resource_sample_s: float = 1.0
+    #: retained resource samples in the in-memory ring
+    resource_capacity: int = 600
+    #: latched retrace_storm trip point: a shape recompiling one jit
+    #: site more than this many times emits the event once
+    retrace_storm_threshold: int = 5
+    #: /dashboard scrape-ring tick (sparkline resolution); <= 0 disables
+    #: the dashboard even when the metrics listener is up
+    dashboard_sample_s: float = 2.0
+    #: retained dashboard ticks (sparkline history length)
+    dashboard_capacity: int = 240
     extra: dict = field(default_factory=dict)
 
 
@@ -242,6 +257,24 @@ class Verifyd:
             archive=self.archive,
             sentinel=self.sentinel,
         )
+        # Runtime introspection: point the process-global JIT tracker at
+        # this daemon's registry + event stream (retrace_storm rides the
+        # stream into the alert engine like every other signal), and arm
+        # the resource sampler feeding gauges + the flight ring.
+        INTROSPECTOR.attach(
+            registry=self.registry,
+            stats=self.stats,
+            storm_threshold=config.retrace_storm_threshold,
+        )
+        self.sampler = None
+        if config.resource_sample_s > 0:
+            self.sampler = ResourceSampler(
+                self.registry,
+                interval_s=config.resource_sample_s,
+                capacity=config.resource_capacity,
+                recorder=self.flight,
+            )
+        self.dashboard = None
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
         )
@@ -307,12 +340,23 @@ class Verifyd:
     # -- lifecycle ----------------------------------------------------------
 
     def __enter__(self) -> "Verifyd":
+        if self.sampler is not None:
+            self.sampler.start()
         if self.cfg.metrics_port is not None:
+            if self.cfg.dashboard_sample_s > 0:
+                self.dashboard = Dashboard(
+                    self.registry,
+                    health=self.health,
+                    sampler=self.sampler,
+                    interval_s=self.cfg.dashboard_sample_s,
+                    capacity=self.cfg.dashboard_capacity,
+                ).start()
             self._metrics_server = MetricsServer(
                 self.registry,
                 self.cfg.metrics_port,
                 health=self.health,
                 sentinel=self.sentinel,
+                dashboard=self.dashboard,
             )
             self.metrics_port = self._metrics_server.port
         self._recover_orphans()
@@ -347,6 +391,14 @@ class Verifyd:
         self.scheduler.stop()
         if self._metrics_server is not None:
             self._metrics_server.close()
+        if self.dashboard is not None:
+            self.dashboard.close()
+        if self.sampler is not None:
+            # Final sample first: the flight ring's last resource record
+            # should reflect the moment of shutdown, not a second before.
+            with contextlib.suppress(Exception):
+                self.sampler.sample_once()
+            self.sampler.close()
         self.stats.emit("serve_stop", **self.stats.snapshot())
         self.dump_flight("shutdown")
         if self.alerts is not None:
@@ -608,6 +660,10 @@ class Verifyd:
                     snap["metrics_port"] = self.metrics_port
                 if self.device_pool is not None:
                     snap["device_pool"] = self.device_pool.snapshot()
+                introspection: dict = {"jit": INTROSPECTOR.snapshot()}
+                if self.sampler is not None:
+                    introspection["resources"] = self.sampler.snapshot()
+                snap["introspection"] = introspection
                 return ok(snap)
             if op == "trace":
                 return ok(self.tracer.export())

@@ -29,6 +29,7 @@ from ..checker.entries import History, prepare
 from ..checker.oracle import CheckOutcome, CheckResult, check
 from ..models.encode import _bucket_chains, _bucket_len, round_pow2
 from ..models.stream import APPEND
+from ..obs.introspect import INTROSPECTOR, job_context
 from ..obs.trace import NULL_TRACER, Tracer
 from .protocol import VERDICT_EXIT, err, ok
 from .queue import AdmissionQueue, Job
@@ -247,7 +248,17 @@ class Scheduler:
                 args={"trace_id": job.trace_id},
             )
         t0 = time.monotonic()
-        res, backend = self._portfolio(job)
+        # Job context for the JIT introspector: anything the portfolio
+        # compiles (inline device escalation included) is attributed to
+        # this job's shape bucket and trace, and jit.compile spans land
+        # on the job's trace track.
+        with job_context(
+            job=job.id,
+            shape=job.shape,
+            trace_id=job.trace_id,
+            tracer=self.tracer,
+        ):
+            res, backend = self._portfolio(job)
         wall = time.monotonic() - t0
         self.tracer.add_span(
             "search",
@@ -350,6 +361,7 @@ class Scheduler:
             )
             self._trace_shards(job, dres, t_dev, t_end)
             self._merge_child_trace(job, dres, t_dev, t_end)
+            self._merge_child_jit(job, dres)
             if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
                 return dres, dev_backend
             if dres is None:
@@ -441,6 +453,16 @@ class Scheduler:
                 child.get("dropped"),
             )
         log.debug("job %d: merged %d child spans", job.id, merged)
+
+    def _merge_child_jit(self, job: Job, res) -> None:
+        """Fold a supervised child's harvested JIT-compile snapshot
+        (``res.child_jit``, the counterpart of ``child_trace``) into the
+        daemon's introspector: the child's compiles/retraces/cache stats
+        land in the parent's ``verifyd_jit_*`` families, and any storm
+        the child latched re-trips here so the alert engine sees it."""
+        child = getattr(res, "child_jit", None)
+        if isinstance(child, dict):
+            INTROSPECTOR.fold(child)
 
     def _escalate_device(self, job: Job) -> tuple[CheckResult | None, str]:
         """Run the device search, leasing a chip set from the pool when one

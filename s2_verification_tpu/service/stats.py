@@ -21,7 +21,10 @@ admission), ``frame_error`` (oversized or malformed frame),
 back onto this same stream so sinks, the flight recorder, and counters
 all see it); ``perf_regression`` (the sentinel's per-shape EWMA
 wall-time drift trip — also re-emitted onto the stream, where the alert
-engine routes it).
+engine routes it); ``retrace_storm`` (the JIT introspector's latched
+per-(site, shape) recompile trip — emitted by
+:data:`~..obs.introspect.INTROSPECTOR` onto this stream, where the
+alert engine routes it like any other signal).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -109,6 +112,7 @@ class ServiceStats:
             "lease_timeouts": 0,
             "slo_breaches": 0,
             "perf_regressions": 0,
+            "retrace_storms": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -211,6 +215,65 @@ class ServiceStats:
             "Shard peak occupancy over mesh mean (1.0 = balanced)",
             labelnames=("shard",),
         )
+        # JIT-compile observability (obs/introspect.py increments these
+        # through the same registry — registering them here, with HELP
+        # text, makes the family headers render from the first scrape).
+        self._m_jit_compiles = r.counter(
+            "verifyd_jit_compiles_total",
+            "XLA compiles at an observed jit site, by site and job shape",
+            labelnames=("site", "shape"),
+        )
+        self._m_jit_retraces = r.counter(
+            "verifyd_jit_retraces_total",
+            "Recompiles at a site that already held an executable "
+            "(fresh abstract shape signature)",
+            labelnames=("site", "shape"),
+        )
+        self._m_jit_cache_hits = r.counter(
+            "verifyd_jit_cache_hits_total",
+            "Observed-jit calls answered by an already-compiled executable",
+            labelnames=("shape",),
+        )
+        self._m_jit_cache_misses = r.counter(
+            "verifyd_jit_cache_misses_total",
+            "Observed-jit calls that had to trace and compile",
+            labelnames=("shape",),
+        )
+        self._m_jit_compile_wall = r.histogram(
+            "verifyd_jit_compile_seconds",
+            "First-call wall time per fresh signature (compile + first "
+            "dispatch), by site",
+            buckets=LATENCY_BUCKETS,
+            labelnames=("site",),
+        )
+        self._m_retrace_storms = r.counter(
+            "verifyd_retrace_storms_total",
+            "Latched retrace-storm trips (a shape recompiling one site "
+            "past the threshold)",
+        )
+        # Resource telemetry (obs/introspect.ResourceSampler sets these).
+        self._m_res_rss = r.gauge(
+            "verifyd_resource_rss_bytes", "Daemon resident set size"
+        )
+        self._m_res_cpu = r.gauge(
+            "verifyd_resource_cpu_seconds",
+            "Cumulative process CPU time (user+system)",
+        )
+        self._m_res_fds = r.gauge(
+            "verifyd_resource_open_fds", "Open file descriptors"
+        )
+        self._m_res_threads = r.gauge(
+            "verifyd_resource_threads", "Live Python threads"
+        )
+        self._m_res_gc = r.gauge(
+            "verifyd_resource_gc_pause_seconds",
+            "Cumulative GC pause time observed via gc callbacks",
+        )
+        self._m_res_devmem = r.gauge(
+            "verifyd_resource_device_memory_bytes",
+            "Per-device bytes in use (when the backend reports memory stats)",
+            labelnames=("device",),
+        )
 
     # -- event stream -------------------------------------------------------
 
@@ -300,7 +363,10 @@ class ServiceStats:
             self._m_submitted.inc()
             self._m_cache_hits.inc()
             if "queue_wait_s" in fields:
-                self._m_queue_wait.observe(float(fields["queue_wait_s"]))
+                self._m_queue_wait.observe(
+                    float(fields["queue_wait_s"]),
+                    exemplar=fields.get("trace_id"),
+                )
         elif event == "decode_error":
             self._counters["submitted"] += 1
             self._counters["decode_errors"] += 1
@@ -324,6 +390,9 @@ class ServiceStats:
             self._counters["slo_breaches"] += 1
         elif event == "perf_regression":
             self._counters["perf_regressions"] += 1
+        elif event == "retrace_storm":
+            self._counters["retrace_storms"] += 1
+            self._m_retrace_storms.inc()
         elif event == "auth_reject":
             self._counters["auth_rejects"] += 1
             self._m_auth_rejects.inc()
@@ -344,7 +413,12 @@ class ServiceStats:
             self._active += 1
             self._m_active.set(self._active)
             if "queue_wait_s" in fields:
-                self._m_queue_wait.observe(float(fields["queue_wait_s"]))
+                # Exemplar: the event's trace_id rides the observation so
+                # an OpenMetrics scrape links the bucket to a timeline.
+                self._m_queue_wait.observe(
+                    float(fields["queue_wait_s"]),
+                    exemplar=fields.get("trace_id"),
+                )
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)
@@ -361,7 +435,11 @@ class ServiceStats:
             if name is not None:
                 self._counters[name] += 1
             self._m_completed.inc(verdict=_VERDICT_LABEL.get(v, "unknown"))
-            self._m_wall.observe(wall, backend=str(fields.get("backend", "unknown")))
+            self._m_wall.observe(
+                wall,
+                exemplar=fields.get("trace_id"),
+                backend=str(fields.get("backend", "unknown")),
+            )
             profile = fields.get("profile")
             if isinstance(profile, dict) and "layers" in profile:
                 self._m_layers.observe(float(profile["layers"]))

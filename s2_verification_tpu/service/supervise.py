@@ -73,6 +73,11 @@ def _result_from_json(obj: dict) -> CheckResult:
     trace = obj.get("trace")
     if isinstance(trace, dict):
         res.child_trace = trace  # type: ignore[attr-defined]
+    # Likewise the child's harvested JIT-compile snapshot: the scheduler
+    # folds it into the daemon's introspector (verifyd_jit_* families).
+    jit = obj.get("jit")
+    if isinstance(jit, dict):
+        res.child_jit = jit  # type: ignore[attr-defined]
     return res
 
 
@@ -200,8 +205,10 @@ def _child_main(argv: list[str]) -> int:
 
     from ..checker.device import check_device_auto
     from ..checker.entries import prepare
+    from ..obs.introspect import INTROSPECTOR, job_context
     from ..obs.trace import Tracer
     from ..utils import events as ev
+    from .scheduler import shape_key
 
     # The child's own span ring: a small Tracer whose wall_base rides the
     # result JSON back so the parent can rebase these spans onto its
@@ -229,13 +236,21 @@ def _child_main(argv: list[str]) -> int:
         # families are fed from the result JSON, profile or not.
         kw["mesh"] = frontier_mesh(devices=[ds[i] for i in devices])
         kw["collect_stats"] = True
-    with tracer.span(
+    # Job context for the observed jit sites: compiles in this child are
+    # attributed to the job's shape bucket, and jit.compile spans land on
+    # the child tracer (merged home with everything else).
+    with job_context(
+        shape=shape_key(hist), trace_id=trace_id, tracer=tracer
+    ), tracer.span(
         "child_search",
         cat="child",
         args={"trace_id": trace_id, "devices": devices or []},
     ):
         res = check_device_auto(hist, checkpoint_path=ckpt_path, **kw)
     out = _result_to_json(res)
+    # Harvest-and-reset: a restarted attempt reports only its own
+    # compiles, so the parent's fold never double-counts.
+    out["jit"] = INTROSPECTOR.snapshot_and_reset()
     out["trace"] = {
         "trace_id": trace_id,
         "pid": os.getpid(),

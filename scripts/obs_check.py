@@ -35,7 +35,20 @@ What it checks (the `make obs` gate):
    read cold (no daemon) with the history corpus intact;
 10. perf sentinel: a synthetic slowdown on one shape_key pushed through
     the live event stream must fire ``perf_regression`` (counter + the
-    ``/sentinel`` endpoint's per-shape state).
+    ``/sentinel`` endpoint's per-shape state);
+11. exemplars: the OpenMetrics variant of /metrics (Accept-negotiated)
+    must carry at least one syntactically valid exemplar whose trace_id
+    is a *real* served job's id, end with ``# EOF``, and leak none of
+    that into the classic 0.0.4 exposition;
+12. /dashboard: the live dashboard must answer 200 with self-contained
+    HTML (inline SVG sparklines) and a ``/dashboard.json`` feed holding
+    non-empty series;
+13. JIT introspection: ``verifyd_jit_*`` families must carry real
+    compile series after a mesh (inline) escalation, and a supervised
+    child's compile activity must fold into the parent's stats op;
+14. resource timeline: a SIGKILLed daemon's state dir must yield a
+    ``doctor`` report (exit 1: unclean) showing the resource timeline
+    sampled before death.
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
 Pure stdlib + the package; runs on CPU in under a minute.
@@ -81,6 +94,27 @@ REQUIRED_SLO_FAMILIES = (
     "verifyd_slo_healthy",
     "verifyd_slo_breaches_total",
 )
+
+#: JIT-introspection families (this PR): headers always render; real
+#: series require an escalated job to exercise the observed jit sites
+REQUIRED_JIT_FAMILIES = (
+    "verifyd_jit_compiles_total",
+    "verifyd_jit_retraces_total",
+    "verifyd_jit_cache_hits_total",
+    "verifyd_jit_cache_misses_total",
+    "verifyd_jit_compile_seconds",
+)
+
+#: resource-telemetry gauges the sampler must keep fresh
+REQUIRED_RESOURCE_FAMILIES = (
+    "verifyd_resource_rss_bytes",
+    "verifyd_resource_cpu_seconds",
+    "verifyd_resource_open_fds",
+    "verifyd_resource_threads",
+)
+
+#: one OpenMetrics exemplar suffix: `` # {trace_id="<32 hex>"} <v> <ts>``
+EXEMPLAR_RE = r'# \{trace_id="([0-9a-f]{32})"\} [0-9.eE+-]+ [0-9.]+$'
 
 #: virtual CPU devices for the mesh phase (set before first jax use)
 MESH_N = 2
@@ -169,6 +203,8 @@ def main() -> int:
             device="off",
             metrics_port=0,  # ephemeral
             profile=True,
+            resource_sample_s=0.1,
+            dashboard_sample_s=0.1,
         )
         with Verifyd(cfg) as daemon:
             client = VerifydClient(sock)
@@ -327,6 +363,110 @@ def main() -> int:
             if "slo" not in snap:
                 return _fail("stats op snapshot lacks the slo section")
 
+            # Introspection families: headers render even before any jit
+            # site runs (the daemon pre-registers them), and the resource
+            # gauges carry live values from the sampler.
+            for fam in REQUIRED_JIT_FAMILIES + REQUIRED_RESOURCE_FAMILIES:
+                if fam not in kinds:
+                    return _fail(f"introspection family {fam} missing")
+            rss_lines = [
+                line
+                for line in body.splitlines()
+                if line.startswith("verifyd_resource_rss_bytes ")
+            ]
+            if not rss_lines or float(rss_lines[0].rsplit(" ", 1)[1]) <= 0:
+                return _fail(
+                    f"verifyd_resource_rss_bytes carries no live value: "
+                    f"{rss_lines}"
+                )
+            intro = snap.get("introspection")
+            if not isinstance(intro, dict) or "jit" not in intro:
+                return _fail("stats op lacks the introspection section")
+            if not (intro.get("resources") or {}).get("samples"):
+                return _fail(
+                    f"resource sampler took no samples: {intro.get('resources')}"
+                )
+
+            # Exemplars: Accept-negotiated OpenMetrics must carry a valid
+            # exemplar bound to a REAL job trace id and end with # EOF —
+            # and none of that may leak into the classic exposition.
+            import re
+
+            om_req = urllib.request.Request(
+                url, headers={"Accept": "application/openmetrics-text"}
+            )
+            with urllib.request.urlopen(om_req, timeout=5) as resp:
+                om_ctype = resp.headers.get("Content-Type", "")
+                om_body = resp.read().decode("utf-8")
+            if "application/openmetrics-text" not in om_ctype:
+                return _fail(f"wrong OpenMetrics Content-Type: {om_ctype!r}")
+            if om_body.rstrip().splitlines()[-1] != "# EOF":
+                return _fail("OpenMetrics exposition does not end with # EOF")
+            ex_ids = {
+                m.group(1)
+                for m in (
+                    re.search(EXEMPLAR_RE, line)
+                    for line in om_body.splitlines()
+                    if "_bucket{" in line
+                )
+                if m
+            }
+            if not ex_ids:
+                return _fail(
+                    "no valid OpenMetrics exemplar on any histogram bucket"
+                )
+            job_tids = {r.get("trace_id") for r in replies}
+            if not ex_ids & job_tids:
+                return _fail(
+                    f"exemplar trace ids {sorted(ex_ids)} match no served "
+                    f"job ({len(job_tids)} jobs)"
+                )
+            if "# {" in body or "# EOF" in body:
+                return _fail(
+                    "exemplar/EOF syntax leaked into the classic 0.0.4 "
+                    "exposition"
+                )
+            exemplars = len(ex_ids)
+
+            # /dashboard: 200, self-contained HTML, live sparkline data.
+            import time as _time
+
+            feed = None
+            for _ in range(100):
+                feed = json.loads(
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/dashboard.json", timeout=5
+                    )
+                    .read()
+                    .decode("utf-8")
+                )
+                if feed.get("retained", 0) >= 2:
+                    break
+                _time.sleep(0.05)
+            if not feed or feed.get("retained", 0) < 2:
+                return _fail(f"dashboard ring never filled: {feed}")
+            series = feed.get("series") or {}
+            if not series or any(
+                len(v) != feed["retained"] for v in series.values()
+            ):
+                return _fail(f"dashboard series empty or ragged: {feed}")
+            if max(series.get("rss_mb") or [0]) <= 0:
+                return _fail(f"dashboard rss_mb series never moved: {series}")
+            dash_resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/dashboard", timeout=5
+            )
+            if dash_resp.status != 200:
+                return _fail(f"/dashboard answered {dash_resp.status}")
+            dash_html = dash_resp.read().decode("utf-8")
+            if not dash_html.startswith("<!DOCTYPE html>"):
+                return _fail("/dashboard body is not an HTML document")
+            for needle in ("<svg", "polyline", "throughput"):
+                if needle not in dash_html:
+                    return _fail(f"/dashboard HTML lacks {needle!r}")
+            if "src=" in dash_html or "href=" in dash_html:
+                return _fail("/dashboard HTML is not self-contained")
+            dash_points = feed["retained"]
+
     # -- mesh phase: per-shard families after a sharded escalation ----------
     from s2_verification_tpu.service import scheduler as sched_mod
     from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
@@ -410,6 +550,35 @@ def main() -> int:
                     return _fail(f"stats op lacks the device_pool snapshot: {pool}")
                 if not pool.get("granted"):
                     return _fail(f"device pool granted no leases: {pool}")
+                # Real JIT series: the inline mesh escalation ran the
+                # observed jit sites in-process, so compile counters must
+                # carry labeled samples, not just family headers.
+                jit_lines = [
+                    line
+                    for line in body.splitlines()
+                    if line.startswith("verifyd_jit_compiles_total{")
+                ]
+                if not jit_lines:
+                    return _fail(
+                        "mesh escalation left no verifyd_jit_compiles_total "
+                        "series"
+                    )
+                jit_sites = {
+                    line.split('site="', 1)[1].split('"', 1)[0]
+                    for line in jit_lines
+                    if 'site="' in line
+                }
+                if "run_search" not in jit_sites:
+                    return _fail(
+                        f"run_search never compiled under introspection: "
+                        f"sites={sorted(jit_sites)}"
+                    )
+                mesh_jit = snap["introspection"]["jit"]
+                if not mesh_jit.get("compiles"):
+                    return _fail(
+                        f"stats op introspection shows no compiles after a "
+                        f"mesh job: {mesh_jit}"
+                    )
     finally:
         sched_mod._cpu_check = real_cpu_check
 
@@ -520,6 +689,11 @@ def main() -> int:
             )
             with Verifyd(cfg) as daemon:
                 client = VerifydClient(sock)
+                # Compile totals before the job: the process-global
+                # tracker still holds the mesh phase's counts, so the
+                # child-fold check below must measure the *delta*.
+                pre_jit = client.stats()["introspection"]["jit"]
+                pre_compiles = sum(pre_jit.get("compiles", {}).values())
                 reply = client.submit(texts[0], client="stitch", timeout=180)
                 tid = reply.get("trace_id")
                 if not tid:
@@ -550,6 +724,17 @@ def main() -> int:
                 if neg:
                     return _fail(f"negative span durations after stitch: {neg}")
                 stitched = len(mine)
+                # The child's compile activity rode the result JSON home:
+                # the parent never ran a jit site itself (CPU stubbed,
+                # search supervised), so any compile growth is the fold.
+                folded = client.stats()["introspection"]["jit"]
+                post_compiles = sum(folded.get("compiles", {}).values())
+                if post_compiles <= pre_compiles:
+                    return _fail(
+                        "supervised child's jit harvest never folded into "
+                        f"the parent ({pre_compiles} -> {post_compiles}): "
+                        f"{folded}"
+                    )
     finally:
         sched_mod._cpu_check = real_cpu_check
 
@@ -744,6 +929,93 @@ def main() -> int:
                 return _fail(f"/sentinel total regressions is zero: {sent}")
             regressions = sent["regressions"]
 
+    # -- doctor phase: SIGKILL a daemon, read the resource timeline ---------
+    import signal
+    import subprocess
+    import time as _time
+
+    with tempfile.TemporaryDirectory(prefix="obs-check-doctor-") as d:
+        sock = os.path.join(d, "verifyd.sock")
+        state = os.path.join(d, "state")
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "s2_verification_tpu.cli",
+                "serve",
+                "--socket",
+                sock,
+                "--state-dir",
+                state,
+                "--device",
+                "off",
+                "--stats-log",
+                "",
+                "--out-dir",
+                os.path.join(d, "viz"),
+                "--resource-sample",
+                "0.05",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            deadline = _time.time() + 60
+            while not os.path.exists(sock):
+                if proc.poll() is not None:
+                    return _fail(
+                        f"doctor-phase daemon died at boot (rc={proc.returncode})"
+                    )
+                if _time.time() > deadline:
+                    return _fail("doctor-phase daemon never bound its socket")
+                _time.sleep(0.05)
+            _time.sleep(0.5)  # a handful of 50ms resource samples
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+
+        doctor = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "s2_verification_tpu.cli",
+                "doctor",
+                "--state-dir",
+                state,
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        # SIGKILL leaves no shutdown dump: the verdict must be unclean
+        # (exit 1), and the report must carry the pre-death timeline.
+        if doctor.returncode != 1:
+            return _fail(
+                f"doctor exited {doctor.returncode} on a SIGKILLed daemon "
+                f"(want 1):\n{doctor.stdout}\n{doctor.stderr}"
+            )
+        if "UNCLEAN DEATH" not in doctor.stdout:
+            return _fail(f"doctor missed the unclean death:\n{doctor.stdout}")
+        if "resource timeline" not in doctor.stdout:
+            return _fail(
+                f"doctor report lacks the resource timeline:\n{doctor.stdout}"
+            )
+        timeline = [
+            line for line in doctor.stdout.splitlines() if "rss=" in line
+        ]
+        if not timeline:
+            return _fail(f"resource timeline has no samples:\n{doctor.stdout}")
+        rss_vals = [
+            float(line.split("rss=", 1)[1].split("MiB", 1)[0])
+            for line in timeline
+        ]
+        if max(rss_vals) <= 0:
+            return _fail(f"resource timeline rss never positive: {timeline}")
+        doctor_samples = len(timeline)
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
         f"{len(spans)} spans, {len(profiled)} profiled jobs, "
@@ -753,7 +1025,12 @@ def main() -> int:
         f"after {errors} induced errors, {stitched} spans stitched under "
         f"one trace id, {alerts_delivered} webhook delivered in "
         f"{alert_attempts} attempts (dedup held), {archived} profiles "
-        f"survived restart, {regressions} sentinel regression(s)"
+        f"survived restart, {regressions} sentinel regression(s), "
+        f"{exemplars} exemplar id(s) matched served jobs, dashboard held "
+        f"{dash_points} sparkline points, {len(jit_sites)} jit site(s) "
+        f"compiled under introspection (child fold "
+        f"{pre_compiles}->{post_compiles}), doctor read {doctor_samples} "
+        f"resource sample(s) off a SIGKILLed daemon"
     )
     return 0
 

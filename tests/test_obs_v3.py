@@ -1,0 +1,445 @@
+"""Obs v3 tests: runtime introspection — the JIT-compile tracker and its
+retrace-storm latch, the child→parent compile fold, resource-sampler ring
+bounds, OpenMetrics exemplar exposition (and its absence from the classic
+format), registry render under concurrent registration, the /dashboard
+surface over a live daemon, the `dash` CLI, the profiles CSV escaping
+regression, and the doctor's resource timeline.
+
+Runs under the session-wide ``JAX_PLATFORMS=cpu`` pin (conftest.py);
+everything here is in-process and fast — the cross-process compile
+harvest is exercised end to end by ``scripts/obs_check.py`` (`make obs`).
+"""
+
+import csv
+import io
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from helpers import H, fold
+from s2_verification_tpu.cli import main as cli_main
+from s2_verification_tpu.obs import (
+    Dashboard,
+    FlightRecorder,
+    JitIntrospector,
+    MetricsRegistry,
+    ResourceSampler,
+    Tracer,
+    job_context,
+    observe_jit,
+    postmortem,
+    render_postmortem,
+)
+from s2_verification_tpu.obs.metrics import OPENMETRICS_CONTENT_TYPE
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.stats import ServiceStats
+from s2_verification_tpu.utils import events as ev
+
+# -- the fake jit site -------------------------------------------------------
+
+
+def _site(tracker, name="fake_site"):
+    calls = []
+
+    @observe_jit(name, tracker=tracker)
+    def fn(x, flag=True):
+        calls.append(x)
+        return x
+
+    return fn, calls
+
+
+def test_compile_tracker_counts_compiles_hits_and_retraces():
+    tr = JitIntrospector()
+    fn, calls = _site(tr)
+    a = np.zeros((4, 8), dtype=np.float32)
+    with job_context(shape="64x5x8"):
+        fn(a)  # first signature -> compile
+        fn(a)  # same signature -> hit
+        fn(np.ones((4, 8), dtype=np.float32))  # same dtype+shape -> hit
+        fn(np.zeros((2, 2), dtype=np.int32))  # new signature -> retrace
+    assert len(calls) == 4  # the wrapper always calls through
+    snap = tr.snapshot()
+    assert snap["compiles"] == {"fake_site\t64x5x8": 2}
+    assert snap["retraces"] == {"fake_site\t64x5x8": 1}
+    assert snap["hits"] == {"64x5x8": 2}
+    assert snap["misses"] == {"64x5x8": 2}
+    assert snap["signatures"] == {"fake_site": 2}
+    assert snap["compile_wall_s"]["fake_site"] >= 0.0
+
+
+def test_static_kwarg_changes_are_their_own_signatures():
+    tr = JitIntrospector()
+    fn, _ = _site(tr)
+    a = np.zeros((3,), dtype=np.float32)
+    fn(a, flag=True)
+    fn(a, flag=False)  # static retoggle -> jit would retrace; so do we
+    fn(a, flag=True)  # cached again
+    snap = tr.snapshot()
+    assert sum(snap["compiles"].values()) == 2
+    assert sum(snap["hits"].values()) == 1
+
+
+def test_compile_records_span_on_the_context_tracer():
+    tr = JitIntrospector()
+    fn, _ = _site(tr)
+    tracer = Tracer(64)
+    with job_context(job=7, shape="s", trace_id="ab" * 16, tracer=tracer):
+        fn(np.zeros((2,), dtype=np.float32))
+    spans = [
+        e
+        for e in tracer.export()["traceEvents"]
+        if e.get("ph") == "X" and e.get("name") == "jit.compile"
+    ]
+    assert len(spans) == 1
+    assert spans[0]["tid"] == 7
+    assert spans[0]["args"]["site"] == "fake_site"
+    assert spans[0]["args"]["trace_id"] == "ab" * 16
+
+
+def test_retrace_storm_is_latched_and_reaches_the_event_stream():
+    stats = ServiceStats(None)
+    tr = JitIntrospector()
+    tr.attach(registry=stats.registry, stats=stats, storm_threshold=2)
+    fn, _ = _site(tr)
+    with job_context(shape="stormy"):
+        for n in (2, 3, 4, 5):  # four distinct signatures, one shape bucket
+            fn(np.zeros((n,), dtype=np.float32))
+    snap = tr.snapshot()
+    assert snap["storms"] == [
+        {"site": "fake_site", "shape": "stormy", "compiles": 3}
+    ]
+    # Exactly one event despite two compiles past the threshold: latched.
+    assert stats.snapshot()["retrace_storms"] == 1
+    rendered = stats.registry.render()
+    assert "verifyd_retrace_storms_total 1" in rendered
+    assert 'verifyd_jit_retraces_total{site="fake_site",shape="stormy"} 3' in rendered
+
+
+def test_fold_merges_child_snapshot_and_retrips_the_storm():
+    stats = ServiceStats(None)
+    parent = JitIntrospector()
+    parent.attach(registry=stats.registry, stats=stats, storm_threshold=2)
+
+    child = JitIntrospector()
+    fn, _ = _site(child, name="regrow")
+    with job_context(shape="64x5x8"):
+        for n in (2, 3, 4):
+            fn(np.zeros((n,), dtype=np.float32))
+    harvest = child.snapshot_and_reset()
+    # The reset half: a restarted attempt starts from zero.
+    assert child.snapshot()["compiles"] == {}
+
+    parent.fold(harvest)
+    snap = parent.snapshot()
+    assert snap["compiles"] == {"regrow\t64x5x8": 3}
+    assert snap["hits"] == {}
+    assert stats.snapshot()["retrace_storms"] == 1
+    # Folding the same counts again adds, but the latch holds.
+    parent.fold(harvest)
+    assert parent.snapshot()["compiles"] == {"regrow\t64x5x8": 6}
+    assert stats.snapshot()["retrace_storms"] == 1
+
+
+def test_attach_replays_accumulated_counts_into_a_fresh_registry():
+    tr = JitIntrospector()
+    fn, _ = _site(tr)
+    with job_context(shape="pre"):
+        fn(np.zeros((2,), dtype=np.float32))
+        fn(np.zeros((2,), dtype=np.float32))
+    reg = MetricsRegistry()
+    tr.attach(registry=reg)
+    text = reg.render()
+    assert 'verifyd_jit_compiles_total{site="fake_site",shape="pre"} 1' in text
+    assert 'verifyd_jit_cache_hits_total{shape="pre"} 1' in text
+
+
+# -- resource sampler --------------------------------------------------------
+
+
+def test_resource_sampler_ring_is_bounded_and_updates_gauges():
+    reg = MetricsRegistry()
+    s = ResourceSampler(reg, interval_s=60.0, capacity=3)
+    for _ in range(7):
+        sample = s.sample_once()
+    assert sample["rss_bytes"] > 0
+    assert sample["threads"] >= 1
+    assert sample["cpu_s"] >= 0.0
+    ring = s.ring()
+    assert len(ring) == 3  # bounded: the four oldest fell off
+    snap = s.snapshot()
+    assert snap["samples"] == 7 and snap["retained"] == 3
+    assert snap["last"]["rss_bytes"] == sample["rss_bytes"]
+    text = reg.render()
+    assert "verifyd_resource_rss_bytes %d" % sample["rss_bytes"] in text
+    assert "verifyd_resource_threads" in text
+
+
+def test_resource_sampler_feeds_the_flight_recorder(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "flight"))
+    s = ResourceSampler(None, interval_s=60.0, recorder=rec)
+    s.sample_once()
+    s.sample_once()
+    rec.close()
+    pm = postmortem(str(tmp_path))
+    assert pm["resource_samples"] == 2
+    assert pm["resources"][-1]["rss_bytes"] > 0
+    report = render_postmortem(pm)
+    assert "resource timeline" in report
+    assert "rss=" in report
+
+
+# -- exemplars ---------------------------------------------------------------
+
+
+def test_openmetrics_exemplars_render_and_classic_text_stays_clean():
+    reg = MetricsRegistry()
+    hist = reg.histogram(
+        "demo_seconds", buckets=(0.1, 1.0), labelnames=("backend",)
+    )
+    tid = "deadbeef" * 4
+    hist.observe(0.05, exemplar=tid, backend="native")
+    hist.observe(0.5, backend="native")  # no exemplar on this bucket
+    om = reg.render_openmetrics()
+    assert om.rstrip().endswith("# EOF")
+    ex_lines = [l for l in om.splitlines() if "# {" in l]
+    assert len(ex_lines) == 1
+    line = ex_lines[0]
+    assert 'le="0.1"' in line
+    assert '# {trace_id="%s"} 0.05' % tid in line
+    # OpenMetrics counter families drop _total from HELP/TYPE only.
+    reg.counter("demo_jobs_total").inc()
+    om = reg.render_openmetrics()
+    assert "# TYPE demo_jobs counter" in om
+    assert "demo_jobs_total 1" in om
+    # The classic 0.0.4 exposition never shows exemplar syntax.
+    classic = reg.render()
+    assert "# {" not in classic
+    assert "# EOF" not in classic
+    assert "# TYPE demo_jobs_total counter" in classic
+
+
+def test_histogram_observe_without_exemplar_keeps_counts_consistent():
+    reg = MetricsRegistry()
+    hist = reg.histogram("h_seconds", buckets=(1.0,))
+    hist.observe(0.5)
+    hist.observe(2.0, exemplar="ab" * 16)
+    cum, total, count = hist.counts()
+    assert count == 2
+    assert total == 2.5
+    assert cum == [1, 2]  # one under le=1.0, both under +Inf
+    snap = reg.snapshot()["histograms"]["h_seconds"]
+    assert snap["count"] == 2
+    # The exemplar rides the snapshot, keyed by its bucket boundary.
+    assert snap["exemplars"]["+Inf"]["trace_id"] == "ab" * 16
+
+
+def test_registry_render_is_safe_against_concurrent_registration():
+    reg = MetricsRegistry()
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            try:
+                reg.counter("churn_%d_total" % (i % 50)).inc()
+                reg.gauge("churn_g_%d" % (i % 50)).set(i)
+            except Exception as e:  # pragma: no cover - the failure mode
+                errors.append(e)
+                return
+            i += 1
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            text = reg.render()
+            assert isinstance(text, str)
+            reg.render_openmetrics()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+
+
+# -- live daemon: /dashboard + stats op + dash CLI ---------------------------
+
+
+def _good_history() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def test_dashboard_and_introspection_over_a_live_daemon(tmp_path, capsys):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        metrics_port=0,
+        resource_sample_s=0.1,
+        dashboard_sample_s=0.1,
+    )
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path)
+        assert client.submit(_good_history(), client="v3")["verdict"] == 0
+        # Let the dashboard thread take at least one post-job sample.
+        for _ in range(100):
+            if daemon.dashboard.payload()["retained"] >= 2:
+                break
+            threading.Event().wait(0.05)
+        port = daemon.metrics_port
+
+        html = (
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/dashboard" % port, timeout=5
+            )
+            .read()
+            .decode()
+        )
+        assert html.startswith("<!DOCTYPE html>")
+        assert "<svg" in html and "polyline" in html
+        assert "throughput" in html and "host RSS" in html
+
+        feed = json.loads(
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/dashboard.json" % port, timeout=5
+            ).read()
+        )
+        assert feed["retained"] >= 2
+        assert set(feed["series"]) >= {"throughput", "queue_depth", "rss_mb"}
+        assert len(feed["series"]["rss_mb"]) == feed["retained"]
+        assert any(v > 0 for v in feed["series"]["rss_mb"])
+
+        # Content negotiation: the OpenMetrics variant ends with EOF, the
+        # classic variant never contains it.
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/metrics" % port,
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.headers["Content-Type"] == OPENMETRICS_CONTENT_TYPE
+            om = resp.read().decode()
+        assert om.rstrip().endswith("# EOF")
+        assert 'trace_id="' in om  # the served job left an exemplar
+        classic = (
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port, timeout=5
+            )
+            .read()
+            .decode()
+        )
+        assert "# EOF" not in classic
+
+        # The stats op carries the introspection section.
+        snap = client.stats()
+        intro = snap["introspection"]
+        assert "jit" in intro and "storm_threshold" in intro["jit"]
+        assert intro["resources"]["last"]["rss_bytes"] > 0
+
+        # One dash frame against the same daemon.
+        rc = cli_main(
+            [
+                "dash",
+                "--socket",
+                cfg.socket_path,
+                "--iterations",
+                "1",
+                "--interval",
+                "0.1",
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "verifyd dash" in out
+        assert "throughput" in out and "rss" in out
+
+
+def test_dashboard_routes_404_without_a_dashboard(tmp_path):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        metrics_port=0,
+        dashboard_sample_s=0.0,  # explicit opt-out
+    )
+    with Verifyd(cfg) as daemon:
+        try:
+            urllib.request.urlopen(
+                "http://127.0.0.1:%d/dashboard" % daemon.metrics_port,
+                timeout=5,
+            )
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:  # pragma: no cover
+            raise AssertionError("expected 404")
+
+
+def test_dashboard_sampling_is_registry_driven():
+    reg = MetricsRegistry()
+    completed = reg.counter("verifyd_jobs_completed_total")
+    ts = iter(float(i) for i in range(100))
+    d = Dashboard(reg, interval_s=1.0, capacity=4, time_fn=lambda: next(ts))
+    d.sample_once()
+    completed.inc(5)
+    d.sample_once()  # 5 completions over a 1s tick → 5 jobs/s
+    assert d.payload()["series"]["throughput"][-1] == 5.0
+    for _ in range(5):
+        d.sample_once()
+    p = d.payload()
+    assert p["retained"] == 4  # bounded ring: oldest samples fell off
+    assert len(p["t"]) == 4
+    assert all(len(s) == 4 for s in p["series"].values())
+    html = d.render_html()
+    assert "<svg" in html
+    assert json.loads(d.render_json())["retained"] == 4
+
+
+# -- profiles CSV escaping (regression) --------------------------------------
+
+
+def test_profiles_csv_export_quotes_commas_and_serializes_containers():
+    from s2_verification_tpu.cli import _PROFILE_COLUMNS, _export_profiles
+
+    records = [
+        {
+            "t": 1.5,
+            "job": 1,
+            "client": 'ci,"weird" bot',
+            "shape": "64x5x8,dense",
+            "backend": "device-mesh[4]",
+            "verdict": 0,
+            "wall_s": 0.25,
+            "queue_wait_s": 0.01,
+            "lease_wait_s": 0.0,
+            "ops": 64,
+            "shards": {"n": 4, "note": 'a,b "c"'},
+            "fp": "ff00",
+        }
+    ]
+    buf = io.StringIO()
+    _export_profiles(records, buf, "csv")
+    text = buf.getvalue()
+    # RFC 4180: embedded quotes doubled inside a quoted cell.
+    assert '"ci,""weird"" bot"' in text
+    rows = list(csv.reader(io.StringIO(text)))
+    assert rows[0] == list(_PROFILE_COLUMNS)
+    row = dict(zip(rows[0], rows[1]))
+    assert row["client"] == 'ci,"weird" bot'
+    assert row["shape"] == "64x5x8,dense"
+    # Container cells come back as JSON, not a Python repr.
+    assert json.loads(row["shards"]) == {"n": 4, "note": 'a,b "c"'}
