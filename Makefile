@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh perfgate clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet perfgate clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: obs mesh
+chaos-full: obs mesh fleet
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -60,6 +60,13 @@ perfgate:
 # per-shard metric families must populate.
 mesh:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/mesh_check.py
+
+# Fleet gate (scripts/fleet_check.py): two subprocess backends behind
+# the router — SIGKILL mid-load loses zero accepted jobs, verdict parity
+# with one-shot check, router /healthz 200 throughout, journal-replay
+# rejoin, clean rolling drain.
+fleet:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_check.py
 
 clean:
 	$(MAKE) -C native clean

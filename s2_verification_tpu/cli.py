@@ -566,6 +566,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         alert_url=args.alert_url or None,
         alert_rules=alert_rules,
         alert_dedup_s=args.alert_dedup,
+        drain_timeout_s=args.drain_timeout,
         sentinel_band=args.sentinel_band,
         sentinel_min_samples=args.sentinel_min_samples,
         resource_sample_s=args.resource_sample,
@@ -587,12 +588,22 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     import signal as _signal
 
     def _stop(signum, frame):
-        log.info("signal %d: stopping verifyd", signum)
         # Black-box dump before teardown: SIGTERM is how orchestration
         # kills a daemon, and the flight tail is the post-mortem story.
         daemon.dump_flight(
             "sigterm" if signum == _signal.SIGTERM else "sigint"
         )
+        if signum == _signal.SIGTERM and cfg.drain_timeout_s > 0:
+            # Rolling-restart contract: finish what was admitted, close
+            # the journal cleanly, then exit.
+            log.info(
+                "signal %d: draining verifyd (up to %.0fs)",
+                signum,
+                cfg.drain_timeout_s,
+            )
+            daemon.request_drain()
+            return
+        log.info("signal %d: stopping verifyd", signum)
         daemon.request_stop()
 
     for sig in (_signal.SIGINT, _signal.SIGTERM):
@@ -602,6 +613,159 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     finally:
         pkg_log.removeHandler(handler)
         pkg_log.propagate = True
+
+
+def _cmd_route_serve(args: argparse.Namespace) -> int:
+    from .service.router import BackendSpec, RouterConfig, VerifydRouter
+
+    secret = _read_secret(args)
+    is_tcp = ":" in args.listen and not args.listen.startswith(("/", "."))
+    if is_tcp and not secret:
+        log.error(
+            "a TCP --listen requires a shared secret (--secret-file or "
+            "VERIFYD_SECRET)"
+        )
+        return USAGE_EXIT
+    if not is_tcp and os.path.exists(args.listen):
+        log.error(
+            "%s already exists — another router running? (remove the file "
+            "if it is stale)",
+            args.listen,
+        )
+        return USAGE_EXIT
+    try:
+        backends = tuple(BackendSpec.parse(spec) for spec in args.backend)
+    except ValueError as e:
+        log.error("bad --backend: %s", e)
+        return USAGE_EXIT
+    try:
+        cfg = RouterConfig(
+            listen=args.listen,
+            backends=backends,
+            secret=secret,
+            probe_interval_s=args.probe_interval,
+            breaker_failures=args.breaker_failures,
+            breaker_reset_s=args.breaker_reset,
+            steal_depth=args.steal_depth,
+            max_failovers=args.max_failovers,
+            submit_timeout_s=args.submit_timeout,
+            ring_replicas=args.ring_replicas,
+            drain_timeout_s=args.drain_timeout,
+            cache_capacity=args.cache_capacity,
+            metrics_port=args.metrics_port,
+            trace_capacity=args.trace_capacity,
+            slo_target=args.slo_target,
+            slo_latency_target_s=args.slo_latency_target,
+        )
+        router = VerifydRouter(cfg)
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+
+    import signal as _signal
+
+    def _stop(signum, frame):
+        log.info("signal %d: stopping verifyd-router", signum)
+        router.request_stop()
+
+    for sig in (_signal.SIGINT, _signal.SIGTERM):
+        _signal.signal(sig, _stop)
+    return router.serve_forever()
+
+
+def _route_client(args: argparse.Namespace):
+    from .service.client import VerifydClient
+
+    return VerifydClient(args.socket, secret=_read_secret(args))
+
+
+def _cmd_route_drain(args: argparse.Namespace) -> int:
+    from .service.client import VerifydError, VerifydUnavailable
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        reply = _route_client(args).drain(
+            args.node, drain_timeout_s=args.timeout, timeout=None
+        )
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    except VerifydUnavailable as e:
+        log.error("cannot reach the router on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydError as e:
+        log.error("drain failed: %s", e)
+        return EXIT_PROTOCOL
+    log.info(
+        "node %s drained (in-flight clear: %s, waited %.2fs); backend "
+        "shutdown: %s",
+        reply.get("node"),
+        reply.get("drained"),
+        reply.get("waited_s", 0.0),
+        reply.get("shutdown"),
+    )
+    return 0 if reply.get("drained") else 1
+
+
+def _cmd_route_undrain(args: argparse.Namespace) -> int:
+    from .service.client import VerifydError, VerifydUnavailable
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        reply = _route_client(args).undrain(args.node)
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    except VerifydUnavailable as e:
+        log.error("cannot reach the router on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydError as e:
+        log.error("undrain failed: %s", e)
+        return EXIT_PROTOCOL
+    log.info("node %s back in the routable set", reply.get("node"))
+    return 0
+
+
+def _cmd_route_fleet(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from .service.client import VerifydError, VerifydUnavailable
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        reply = _route_client(args).fleet()
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    except VerifydUnavailable as e:
+        log.error("cannot reach the router on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydError as e:
+        log.error("fleet query failed: %s", e)
+        return EXIT_PROTOCOL
+    if args.json:
+        print(_json.dumps(reply, indent=2, sort_keys=True))
+        return 0
+    ring = reply.get("ring", {})
+    print(
+        f"ring: {len(ring.get('nodes', []))} nodes × "
+        f"{ring.get('replicas')} replicas"
+    )
+    for b in reply.get("backends", []):
+        up = {True: "up", False: "DOWN", None: "unprobed"}[b.get("up")]
+        flags = []
+        if b.get("draining"):
+            flags.append("draining")
+        if b.get("breaker") != "closed":
+            flags.append(f"breaker={b.get('breaker')}")
+        if b.get("last_error"):
+            flags.append(f"last_error={b['last_error']}")
+        print(
+            f"  {b.get('name')}: {up}  addr={b.get('address')}  "
+            f"in_flight={b.get('in_flight')}"
+            + (f"  [{', '.join(flags)}]" if flags else "")
+        )
+    return 0
 
 
 def _cmd_doctor(args: argparse.Namespace) -> int:
@@ -969,6 +1133,7 @@ def _cmd_submit(args: argparse.Namespace) -> int:
             timeout=args.timeout,
             retries=args.retries,
             backoff_s=args.backoff,
+            deadline_s=args.deadline,
         )
     except VerifydBusy as e:
         log.error(
@@ -1355,7 +1520,205 @@ def build_parser() -> argparse.ArgumentParser:
         "listener (needs --metrics-port; default 2.0; <=0 disables "
         "the dashboard)",
     )
+    s.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="graceful drain budget: on SIGTERM (or a drain-flagged "
+        "shutdown op) stop admitting, let queued + in-flight jobs "
+        "finish up to this many seconds, close the journal cleanly, "
+        "then exit.  0 (default) keeps the immediate-stop behavior; "
+        "the router's rolling restart needs this > 0",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
+
+    r = sub.add_parser(
+        "route",
+        help="verifyd-router: front N verifyd daemons behind one address "
+        "(consistent-hash cache affinity, work stealing, circuit-broken "
+        "failover, rolling restarts)",
+    )
+    rsub = r.add_subparsers(dest="route_cmd", required=True)
+
+    rs = rsub.add_parser(
+        "serve", help="run the router daemon in the foreground"
+    )
+    rs.add_argument(
+        "--listen",
+        required=True,
+        metavar="SOCK|HOST:PORT",
+        help="router address clients dial: a unix-socket path, or "
+        "HOST:PORT for the authenticated TCP transport (needs "
+        "--secret-file / VERIFYD_SECRET; port 0 = ephemeral)",
+    )
+    rs.add_argument(
+        "--backend",
+        action="append",
+        required=True,
+        metavar="NAME=ADDR[@HEALTHZ_URL]",
+        help="fleet member (repeatable): NAME names the node in metrics "
+        "and drain commands; ADDR is its unix socket or HOST:PORT "
+        "(TCP backends share the router's secret); the optional "
+        "HEALTHZ_URL switches probing from TCP ping to the daemon's "
+        "HTTP /healthz (real 200/503 SLO state)",
+    )
+    rs.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the shared secret for the TCP listener and "
+        "TCP backends; falls back to VERIFYD_SECRET",
+    )
+    rs.add_argument(
+        "--probe-interval",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="health-probe period per backend (default 1.0)",
+    )
+    rs.add_argument(
+        "--breaker-failures",
+        type=int,
+        default=3,
+        metavar="N",
+        help="consecutive transport failures before a backend's circuit "
+        "breaker opens (default 3)",
+    )
+    rs.add_argument(
+        "--breaker-reset",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help="open-breaker wait before admitting one half-open probe "
+        "request (default 5.0)",
+    )
+    rs.add_argument(
+        "--steal-depth",
+        type=int,
+        default=4,
+        metavar="N",
+        help="router-side in-flight on the home node at which a cold "
+        "job is work-stolen to the least loaded healthy node "
+        "(default 4)",
+    )
+    rs.add_argument(
+        "--max-failovers",
+        type=int,
+        default=3,
+        metavar="N",
+        help="failover hops after the first attempt before answering "
+        "NoBackend (default 3)",
+    )
+    rs.add_argument(
+        "--submit-timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-attempt verdict wait against one backend "
+        "(default: wait)",
+    )
+    rs.add_argument(
+        "--ring-replicas",
+        type=int,
+        default=64,
+        metavar="N",
+        help="virtual nodes per backend on the consistent-hash ring "
+        "(default 64)",
+    )
+    rs.add_argument(
+        "--drain-timeout",
+        type=float,
+        default=30.0,
+        metavar="SECONDS",
+        help="default budget a `route drain` waits for in-flight work "
+        "(default 30)",
+    )
+    rs.add_argument(
+        "--cache-capacity",
+        type=int,
+        default=4096,
+        metavar="N",
+        help="router edge cache: decided verdicts answered at the "
+        "router with no backend hop (entries; 0 disables; default 4096)",
+    )
+    rs.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="router /metrics + /healthz + /slo listener "
+        "(0 = ephemeral; default: off)",
+    )
+    rs.add_argument(
+        "--trace-capacity",
+        type=int,
+        default=4096,
+        metavar="SPANS",
+        help="router span-ring capacity for the stitched `trace` op "
+        "(0 disables; default 4096)",
+    )
+    rs.add_argument(
+        "--slo-target",
+        type=float,
+        default=0.99,
+        metavar="FRACTION",
+        help="router availability SLO target for /healthz (default 0.99)",
+    )
+    rs.add_argument(
+        "--slo-latency-target",
+        type=float,
+        default=5.0,
+        metavar="SECONDS",
+        help="routed-submit p95 latency target (default 5.0)",
+    )
+    rs.set_defaults(fn=_cmd_route_serve)
+
+    def _route_op_parser(name: str, help_text: str):
+        rp = rsub.add_parser(name, help=help_text)
+        rp.add_argument(
+            "-socket",
+            "--socket",
+            required=True,
+            help="the router's unix-socket path or HOST:PORT",
+        )
+        rp.add_argument(
+            "--secret-file",
+            default=None,
+            help="shared secret for a TCP router address; falls back to "
+            "VERIFYD_SECRET",
+        )
+        return rp
+
+    rd = _route_op_parser(
+        "drain",
+        "rolling restart, step 1: stop routing to NODE, wait for its "
+        "in-flight, then send it a drain-aware shutdown (the restarted "
+        "node replays its journal and rejoins via the health probe)",
+    )
+    rd.add_argument("node", help="backend name (as given to --backend)")
+    rd.add_argument(
+        "--timeout",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="drain budget override (default: the router's "
+        "--drain-timeout)",
+    )
+    rd.set_defaults(fn=_cmd_route_drain)
+
+    ru = _route_op_parser(
+        "undrain", "return a drained node to the routable set"
+    )
+    ru.add_argument("node", help="backend name (as given to --backend)")
+    ru.set_defaults(fn=_cmd_route_undrain)
+
+    rf = _route_op_parser(
+        "fleet", "show ring membership + per-backend health/drain state"
+    )
+    rf.add_argument(
+        "--json", action="store_true", help="emit the raw fleet JSON"
+    )
+    rf.set_defaults(fn=_cmd_route_fleet)
 
     d = sub.add_parser(
         "doctor",
@@ -1576,6 +1939,17 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SECONDS",
         help="base of the exponential retry backoff: attempt n sleeps "
         "uniform(0, SECONDS * 2^n), capped at 30s (default 0.5)",
+    )
+    u.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="total wall-clock budget across all attempts and retry "
+        "sleeps: per-attempt timeouts are clamped to what remains, and "
+        "a spent budget exits 69 with 'deadline exceeded after N "
+        "attempts' — bounds a retry loop against a flapping node "
+        "(default: unbounded)",
     )
     u.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"

@@ -35,25 +35,41 @@ Durability and remote access ride three more:
 same newline-delimited-JSON framing discipline as ``collector/socket_s2.py``;
 :mod:`.client` is the submit side; :mod:`.stats` emits per-job structured
 log events (queue wait, backend chosen, cache hit/miss, wall time).
+
+Horizontal scale rides one more: :mod:`.router` fronts N daemons behind
+a single address speaking the same protocol — consistent-hash routing on
+the verdict-cache fingerprint, bounded work-stealing, circuit-broken
+failover, and drain-aware rolling restarts (``route`` CLI subcommand).
 """
 
 from .cache import VerdictCache, history_fingerprint
-from .client import VerifydBusy, VerifydClient, VerifydError
+from .client import (
+    VerifydBusy,
+    VerifydClient,
+    VerifydDeadlineExceeded,
+    VerifydError,
+)
 from .daemon import Verifyd, VerifydConfig
 from .queue import AdmissionQueue, Job, QueueFull
+from .router import BackendSpec, HashRing, RouterConfig, VerifydRouter
 from .scheduler import shape_key
 from .stats import ServiceStats
 
 __all__ = [
     "AdmissionQueue",
+    "BackendSpec",
+    "HashRing",
     "Job",
     "QueueFull",
+    "RouterConfig",
     "ServiceStats",
     "Verifyd",
     "VerifydBusy",
     "VerifydClient",
     "VerifydConfig",
+    "VerifydDeadlineExceeded",
     "VerifydError",
+    "VerifydRouter",
     "VerdictCache",
     "history_fingerprint",
     "shape_key",

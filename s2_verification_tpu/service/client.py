@@ -41,7 +41,9 @@ from ..obs.context import TRACE_FIELD, new_trace_id, trace_frame
 from .protocol import (
     ERR_AUTH,
     ERR_FRAME,
+    ERR_NO_BACKEND,
     ERR_QUEUE_FULL,
+    ERR_SHUTTING_DOWN,
     ERR_TOO_LARGE,
     encode_frame,
     parse_hostport,
@@ -54,6 +56,7 @@ __all__ = [
     "VerifydBusy",
     "VerifydUnavailable",
     "VerifydRefused",
+    "VerifydDeadlineExceeded",
     "VerifydClient",
 ]
 
@@ -80,6 +83,21 @@ class VerifydUnavailable(VerifydError):
     """No daemon ever answered a connect (CLI exit 69, EX_UNAVAILABLE)."""
 
 
+class VerifydDeadlineExceeded(VerifydUnavailable):
+    """``submit --deadline`` wall-clock budget spent before any attempt
+    succeeded (CLI exit 69 — the service was effectively unavailable for
+    the whole window the caller was willing to wait)."""
+
+    def __init__(self, deadline_s: float, attempts: int, last: str) -> None:
+        super().__init__(
+            "DeadlineExceeded",
+            f"deadline exceeded after {attempts} attempts"
+            f" ({deadline_s:g}s budget; last error: {last})",
+        )
+        self.deadline_s = deadline_s
+        self.attempts = attempts
+
+
 class VerifydRefused(VerifydError):
     """A daemon was reached but refused or broke the exchange (CLI exit
     76, EX_PROTOCOL after retries).  ``transient`` marks flavors worth
@@ -100,6 +118,12 @@ class VerifydRefused(VerifydError):
 
 #: error-frame classes that are transport noise, not semantic failures
 _REFUSAL_CLASSES = {ERR_FRAME, ERR_TOO_LARGE, ERR_AUTH}
+
+#: semantic answers that are transient by contract — a draining daemon
+#: restarts, a router's empty routable set refills on the next probe
+#: tick — so the retry loop treats them like backoff-worthy transport
+#: failures rather than definite refusals
+_TRANSIENT_CLASSES = {ERR_SHUTTING_DOWN, ERR_NO_BACKEND}
 
 
 class VerifydClient:
@@ -222,8 +246,49 @@ class VerifydClient:
         req.update({k: v for k, v in filters.items() if v is not None})
         return self._call(req, timeout=timeout)
 
-    def shutdown(self, timeout: float | None = 10.0) -> dict:
-        return self._call({"op": "shutdown"}, timeout=timeout)
+    def shutdown(
+        self,
+        timeout: float | None = 10.0,
+        *,
+        drain: bool = False,
+        drain_timeout_s: float | None = None,
+    ) -> dict:
+        """Stop the daemon.  With ``drain=True`` the daemon stops
+        admitting, finishes in-flight work up to ``drain_timeout_s`` (its
+        ``--drain-timeout`` default when None), closes the journal
+        cleanly, then exits — the rolling-restart path."""
+        req: dict = {"op": "shutdown"}
+        if drain:
+            req["drain"] = True
+            if drain_timeout_s is not None:
+                req["timeout"] = drain_timeout_s
+        return self._call(req, timeout=timeout)
+
+    # -- router ops (service/router.py speaks the same protocol) -------------
+
+    def fleet(self, timeout: float | None = 10.0) -> dict:
+        """Router only: ring membership + per-backend health/drain state."""
+        return self._call({"op": "fleet"}, timeout=timeout)
+
+    def drain(
+        self,
+        node: str,
+        *,
+        drain_timeout_s: float | None = None,
+        timeout: float | None = None,
+    ) -> dict:
+        """Router only: drain ``node`` out of the fleet (stop routing,
+        wait for in-flight, drain-aware backend shutdown).  The call
+        blocks until the drain completes, so the default wire timeout is
+        None (wait)."""
+        req: dict = {"op": "drain", "node": node}
+        if drain_timeout_s is not None:
+            req["timeout"] = drain_timeout_s
+        return self._call(req, timeout=timeout)
+
+    def undrain(self, node: str, timeout: float | None = 10.0) -> dict:
+        """Router only: return a drained node to the routable set."""
+        return self._call({"op": "undrain", "node": node}, timeout=timeout)
 
     def submit(
         self,
@@ -263,6 +328,7 @@ class VerifydClient:
         retries: int = 0,
         backoff_s: float = 0.5,
         max_retry_wait_s: float = 30.0,
+        deadline_s: float | None = None,
         rng: random.Random | None = None,
         **kw,
     ) -> dict:
@@ -278,23 +344,73 @@ class VerifydClient:
         identical bytes cannot change those answers.  After ``retries``
         re-submissions the last error propagates for the CLI's exit-code
         mapping (75 busy / 69 unavailable / 76 refused).
+
+        ``deadline_s`` caps total wall-clock across *all* attempts and
+        sleeps (``submit --deadline``): per-attempt timeouts are clamped
+        to the remaining budget, sleeps are truncated, and when the
+        budget is spent :class:`VerifydDeadlineExceeded` raises — so a
+        client cannot spin forever against a flapping node regardless of
+        the attempt count.
         """
         rng = rng or random.Random()
         # One logical request = one trace id, however many wire attempts.
         kw.setdefault("trace_id", new_trace_id())
+        t0 = time.monotonic()
+        caller_timeout = kw.pop("timeout", None)
+
+        def _remaining() -> float | None:
+            if deadline_s is None:
+                return None
+            return deadline_s - (time.monotonic() - t0)
+
+        def _sleep(want_s: float, attempts: int, last: str) -> None:
+            rem = _remaining()
+            if rem is not None:
+                if rem <= want_s:
+                    # Sleeping would spend the rest of the budget with no
+                    # attempt left to show for it — fail now, honestly.
+                    raise VerifydDeadlineExceeded(deadline_s, attempts, last)
+                want_s = min(want_s, rem)
+            time.sleep(max(0.0, want_s))
+
         for attempt in range(retries + 1):
+            rem = _remaining()
+            if rem is not None and rem <= 0:
+                raise VerifydDeadlineExceeded(
+                    deadline_s, attempt, "budget spent before attempt"
+                )
+            tmo = caller_timeout
+            if rem is not None:
+                tmo = rem if tmo is None else min(tmo, rem)
             try:
-                return self.submit(history_text, **kw)
+                return self.submit(history_text, timeout=tmo, **kw)
             except VerifydBusy as e:
                 if attempt == retries:
                     raise
-                time.sleep(min(e.retry_after_s, max_retry_wait_s))
+                _sleep(
+                    min(e.retry_after_s, max_retry_wait_s),
+                    attempt + 1,
+                    f"{e.cls}: {e.msg}",
+                )
             except (VerifydUnavailable, VerifydRefused) as e:
                 if isinstance(e, VerifydRefused) and not e.transient:
                     raise
                 if attempt == retries:
                     raise
-                time.sleep(
-                    min(max_retry_wait_s, rng.uniform(0, backoff_s * (2**attempt)))
+                _sleep(
+                    min(max_retry_wait_s, rng.uniform(0, backoff_s * (2**attempt))),
+                    attempt + 1,
+                    f"{e.cls}: {e.msg}",
+                )
+            except VerifydError as e:
+                # ShuttingDown / NoBackend: transient by contract (the
+                # drained daemon restarts, the router's routable set
+                # refills).  Everything else semantic is definite.
+                if e.cls not in _TRANSIENT_CLASSES or attempt == retries:
+                    raise
+                _sleep(
+                    min(max_retry_wait_s, rng.uniform(0, backoff_s * (2**attempt))),
+                    attempt + 1,
+                    f"{e.cls}: {e.msg}",
                 )
         raise AssertionError("unreachable")

@@ -32,6 +32,7 @@ import logging
 import os
 import sys
 import threading
+import time
 from dataclasses import dataclass, field
 
 from .. import version as _version
@@ -106,6 +107,12 @@ class VerifydConfig:
     #: TCP per-frame *read* deadline (slowloris bound) — the deferred
     #: submit reply is bounded by the scheduler's budgets, not this
     conn_deadline_s: float = 30.0
+    #: graceful-drain budget (``serve --drain-timeout``): on SIGTERM or a
+    #: drain-flagged shutdown op, stop admitting, let queued + in-flight
+    #: jobs finish up to this many seconds, close the journal cleanly,
+    #: then stop.  0 keeps the historical behavior (immediate stop) —
+    #: the router's rolling restart needs this > 0
+    drain_timeout_s: float = 0.0
     #: durable-state root (verdict segments + admission journal); None =
     #: in-memory only, the pre-durability behavior
     state_dir: str | None = None
@@ -325,6 +332,12 @@ class Verifyd:
             lease_timeout_s=config.lease_timeout_s,
         )
         self._job_ids = itertools.count(1)
+        #: submits between dispatch and reply-written (loop thread owns
+        #: the writes; the drain poller only reads)
+        self._inflight = 0
+        self._drain_lock = threading.Lock()
+        self._draining = False
+        self._drain_thread: threading.Thread | None = None
         self._thread: threading.Thread | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._started = threading.Event()
@@ -484,6 +497,65 @@ class Verifyd:
         if self.flight is not None:
             self.flight.dump(reason, slo=self.health.snapshot())
 
+    def request_drain(self, timeout_s: float | None = None) -> float:
+        """Graceful drain, then stop (drain-flagged shutdown op, SIGTERM
+        under ``serve --drain-timeout``).  Thread-safe and idempotent.
+
+        Closes the admission queue immediately — new submits answer
+        ``ShuttingDown``, workers finish what is queued — then a
+        background thread waits until every dispatched submit has its
+        reply written, the queue is empty, and no worker holds an active
+        job (or the budget runs out), and finally triggers the normal
+        stop path, which closes the journal and verdict segments
+        cleanly.  Cache hits keep answering throughout: they touch no
+        queue slot and cost nothing.  Returns the effective budget.
+        """
+        t = float(
+            timeout_s
+            if timeout_s is not None
+            else (self.cfg.drain_timeout_s or 30.0)
+        )
+        with self._drain_lock:
+            if self._draining:
+                return t
+            self._draining = True
+
+        def _drain() -> None:
+            self.queue.close()
+            self.stats.emit(
+                "drain_start",
+                queued=len(self.queue),
+                inflight=self._inflight,
+                active=self.stats.active,
+                timeout_s=t,
+            )
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < t:
+                if (
+                    self._inflight == 0
+                    and len(self.queue) == 0
+                    and self.stats.active == 0
+                ):
+                    break
+                time.sleep(0.05)
+            waited = time.monotonic() - t0
+            clean = (
+                self._inflight == 0
+                and len(self.queue) == 0
+                and self.stats.active == 0
+            )
+            self.stats.emit(
+                "drain_done", waited_s=round(waited, 3), clean=clean
+            )
+            self.dump_flight("drain")
+            self.request_stop()
+
+        self._drain_thread = threading.Thread(
+            target=_drain, name="verifyd-drain", daemon=True
+        )
+        self._drain_thread.start()
+        return t
+
     def request_stop(self) -> None:
         """Thread-safe stop trigger (shutdown op, signal handler)."""
         self._stopped.set()
@@ -605,24 +677,35 @@ class Verifyd:
                 if not line:
                     break
                 close_after = False
+                inflight = False
                 try:
-                    req = decode_frame(line)
-                except ValueError as e:
-                    self.stats.emit("frame_error", reason="decode")
-                    resp = err(ERR_FRAME, f"malformed frame: {e}")
-                else:
-                    if secret is not None and not verify_frame(req, secret):
-                        # Rejected before admission: nothing below the
-                        # transport ever sees an unauthenticated frame.
-                        peer = writer.get_extra_info("peername")
-                        self.stats.emit(
-                            "auth_reject", op=req.get("op"), peer=str(peer)
-                        )
-                        resp = err(ERR_AUTH, "missing or invalid frame auth")
-                        close_after = True
+                    try:
+                        req = decode_frame(line)
+                    except ValueError as e:
+                        self.stats.emit("frame_error", reason="decode")
+                        resp = err(ERR_FRAME, f"malformed frame: {e}")
                     else:
-                        resp = await self._dispatch(req)
-                await self._reply(writer, resp, secret)
+                        if secret is not None and not verify_frame(req, secret):
+                            # Rejected before admission: nothing below the
+                            # transport ever sees an unauthenticated frame.
+                            peer = writer.get_extra_info("peername")
+                            self.stats.emit(
+                                "auth_reject", op=req.get("op"), peer=str(peer)
+                            )
+                            resp = err(ERR_AUTH, "missing or invalid frame auth")
+                            close_after = True
+                        else:
+                            if req.get("op") == "submit":
+                                # Drain counts a submit until its reply is
+                                # *written* — an accepted job whose verdict
+                                # never reached the client is a lost job.
+                                inflight = True
+                                self._inflight += 1
+                            resp = await self._dispatch(req)
+                    await self._reply(writer, resp, secret)
+                finally:
+                    if inflight:
+                        self._inflight -= 1
                 if close_after:
                     break
         except (ConnectionResetError, BrokenPipeError):
@@ -700,6 +783,20 @@ class Verifyd:
                     }
                 )
             if op == "shutdown":
+                if req.get("drain"):
+                    tmo = req.get("timeout")
+                    try:
+                        tmo = float(tmo) if tmo is not None else None
+                    except (TypeError, ValueError):
+                        return err(ERR_DECODE, "timeout must be a number")
+                    effective = self.request_drain(tmo)
+                    return ok(
+                        {
+                            "stopping": True,
+                            "draining": True,
+                            "timeout_s": effective,
+                        }
+                    )
                 self.request_stop()
                 return ok({"stopping": True})
             if op == "submit":

@@ -38,7 +38,25 @@ Ops:
                 time), ``limit`` (newest N; defaults to 100 when no
                 other cut is given).  Reply:
                 ``{"ok": {"records": [...], "total": <archived>}}``.
-``shutdown``  → acks, then stops the daemon.
+``shutdown``  → acks, then stops the daemon.  Optional ``drain``
+                (bool) + ``timeout`` (seconds): stop admitting, let
+                in-flight jobs finish up to the deadline, close the
+                journal cleanly, then stop — the router's rolling
+                restart sends this.
+
+Router ops (``service/router.py`` speaks this same protocol and adds):
+
+``fleet``     → ``{"ok": {"ring": {...}, "backends": [...]}}`` —
+                per-backend up/draining/breaker/in-flight state.
+``drain``     → ``{"node": <name>, "timeout": <s>}``: stop routing new
+                work to the node, wait for router-side in-flight, then
+                send it a drain-aware ``shutdown``.
+``undrain``   → put a drained node back in the routable set.
+
+A router ``submit`` may also fail with ``NoBackend`` (transient: every
+routable backend was tried and none answered — retry like
+``ShuttingDown``); successes carry ``node`` (which backend answered)
+and ``stolen`` when work-stealing rerouted a cold job.
 
 Frame bounds: the daemon reads at most ``MAX_FRAME_BYTES`` per frame
 (configurable) and answers an oversized frame with the **definite**
@@ -87,6 +105,7 @@ __all__ = [
     "ERR_AUTH",
     "ERR_INTERNAL",
     "ERR_SHUTTING_DOWN",
+    "ERR_NO_BACKEND",
     "EXIT_BUSY",
     "EXIT_UNAVAILABLE",
     "EXIT_PROTOCOL",
@@ -115,6 +134,10 @@ ERR_TOO_LARGE = "FrameTooLarge"
 ERR_AUTH = "AuthError"
 ERR_INTERNAL = "InternalError"
 ERR_SHUTTING_DOWN = "ShuttingDown"
+#: Router-only: every routable backend was tried (or none existed) and
+#: the submit could not be placed.  Transient — clients retry like
+#: :data:`ERR_SHUTTING_DOWN`.
+ERR_NO_BACKEND = "NoBackend"
 
 #: check-CLI exit code per outcome value (cli.py docstring contract).
 VERDICT_EXIT = {"ok": 0, "illegal": 1, "unknown": 2}

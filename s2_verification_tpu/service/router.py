@@ -1,0 +1,1106 @@
+"""verifyd-router: a failure-tolerant routing tier over N verifyd daemons.
+
+One daemon per host is the serving ceiling; the router federates a fleet
+behind a single address speaking the *same* newline-delimited-JSON
+protocol (:mod:`.protocol`), so every existing client — ``submit``,
+``service_bench``, the chaos harness — points at the router unchanged.
+
+Routing discipline, per submit:
+
+- The router decodes the history and computes the canonical chain-hash
+  :func:`~.cache.history_fingerprint` — the verdict-cache key — and
+  consistent-hashes it onto the backend ring (:class:`HashRing`).
+  Duplicate traffic (the dominant serving pattern) therefore always
+  lands on the node whose verdict cache is already warm.
+- **Work stealing**: when the home node is saturated (router-side
+  in-flight at/above ``steal_depth``, or a ``QueueFull`` answer riding
+  its ``retry_after_s`` hint), the job is bounded-stolen to the least
+  loaded healthy node instead of queueing behind the hot shard.
+- **Failover**: a transport failure (node died mid-verdict, connection
+  refused) records a :class:`~..obs.probe.CircuitBreaker` failure and
+  retries the submit on the next node in ring-preference order.  This
+  is *safe* because submits are idempotent by fingerprint: the dead
+  node's write-ahead journal replays the accepted job at restart and
+  parks the verdict in its durable cache — nobody double-answers, and
+  no accepted job is lost.
+- **Health**: a :class:`~..obs.probe.HealthProber` polls each backend
+  (HTTP ``/healthz`` when configured, TCP ``ping`` otherwise); a down
+  node leaves the routable set immediately, and the up-edge after a
+  restart clears its draining flag and resets its breaker — the ring
+  re-absorbs the node with no operator action.
+
+Rolling restarts: the ``drain`` op stops routing to one node, waits for
+the router's in-flight on it to clear, then sends the backend a
+drain-aware ``shutdown`` (``serve --drain-timeout`` finishes in-flight
+work and closes the journal cleanly).  The replacement replays its
+journal and rejoins via the prober's up-edge.
+
+Observability mirrors the daemon's: per-backend ``verifyd_router_*``
+gauges/counters/latency histograms on the router's own ``/metrics``
+listener, an SLO rollup (``/slo``, real 200/503 ``/healthz``) fed by
+routed outcomes, and a span ring whose ``trace`` op returns a *stitched*
+export — router spans plus every backend's ring (which already contains
+merged child spans), pid-remapped per node — so one Perfetto timeline
+spans router → daemon → supervised child.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+import contextlib
+import functools
+import hashlib
+import itertools
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import version as _version
+from ..checker.entries import prepare
+from ..obs.context import TRACE_FIELD, new_trace_id, parse_trace_frame
+from ..obs.health import SLOConfig, SLOHealth
+from ..obs.httpd import MetricsServer
+from ..obs.metrics import LATENCY_BUCKETS, MetricsRegistry
+from ..obs.probe import CircuitBreaker, HealthProber, http_health_probe
+from ..obs.trace import Tracer
+from ..utils import events as ev
+from .cache import history_fingerprint
+from .client import (
+    VerifydBusy,
+    VerifydClient,
+    VerifydError,
+    VerifydRefused,
+    VerifydUnavailable,
+)
+from .protocol import (
+    ERR_AUTH,
+    ERR_DECODE,
+    ERR_FRAME,
+    ERR_INTERNAL,
+    ERR_NO_BACKEND,
+    ERR_QUEUE_FULL,
+    ERR_SHUTTING_DOWN,
+    ERR_TOO_LARGE,
+    MAX_FRAME_BYTES,
+    PROTOCOL_VERSION,
+    decode_frame,
+    encode_frame,
+    err,
+    ok,
+    parse_hostport,
+    sign_frame,
+    verify_frame,
+)
+
+__all__ = ["BackendSpec", "HashRing", "RouterConfig", "VerifydRouter"]
+
+log = logging.getLogger("s2_verification_tpu.router")
+
+
+# -- consistent hashing ------------------------------------------------------
+
+
+def _ring_hash(s: str) -> int:
+    """Stable 64-bit point on the ring (never Python's salted hash())."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    ``replicas`` virtual points per node keep key ownership balanced;
+    adding or removing one node remaps only ~1/N of the keyspace (the
+    stability property the tests pin).  ``preference(key)`` walks the
+    ring clockwise from the key's point and returns every distinct node
+    in encounter order — position 0 is the home node, the rest are the
+    failover order.
+    """
+
+    def __init__(self, nodes: Sequence[str] = (), replicas: int = 64) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.replicas = replicas
+        self._lock = threading.Lock()
+        self._points: List[Tuple[int, str]] = []
+        self._nodes: set = set()
+        for n in nodes:
+            self.add(n)
+
+    def add(self, node: str) -> None:
+        with self._lock:
+            if node in self._nodes:
+                return
+            self._nodes.add(node)
+            for r in range(self.replicas):
+                bisect.insort(self._points, (_ring_hash(f"{node}#{r}"), node))
+
+    def remove(self, node: str) -> None:
+        with self._lock:
+            if node not in self._nodes:
+                return
+            self._nodes.discard(node)
+            self._points = [p for p in self._points if p[1] != node]
+
+    def nodes(self) -> List[str]:
+        with self._lock:
+            return sorted(self._nodes)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def preference(self, key: str) -> List[str]:
+        """All nodes in clockwise encounter order from ``key``'s point."""
+        with self._lock:
+            if not self._points:
+                return []
+            start = bisect.bisect_left(self._points, (_ring_hash(key), ""))
+            out: List[str] = []
+            seen: set = set()
+            n = len(self._points)
+            for i in range(n):
+                node = self._points[(start + i) % n][1]
+                if node not in seen:
+                    seen.add(node)
+                    out.append(node)
+                if len(seen) == len(self._nodes):
+                    break
+            return out
+
+    def lookup(self, key: str) -> Optional[str]:
+        pref = self.preference(key)
+        return pref[0] if pref else None
+
+
+# -- backend bookkeeping -----------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BackendSpec:
+    """One fleet member: ``name=address[@healthz_url]`` on the CLI."""
+
+    name: str
+    address: str  # unix-socket path or host:port (TCP needs the secret)
+    healthz_url: Optional[str] = None
+
+    @classmethod
+    def parse(cls, spec: str) -> "BackendSpec":
+        name, sep, rest = spec.partition("=")
+        if not sep or not name or not rest:
+            raise ValueError(
+                f"expected NAME=ADDR[@HEALTHZ_URL], got {spec!r}"
+            )
+        addr, sep, healthz = rest.partition("@")
+        return cls(name, addr, healthz or None)
+
+
+class _Backend:
+    """Router-side state for one verifyd node."""
+
+    def __init__(self, spec: BackendSpec, breaker: CircuitBreaker) -> None:
+        self.spec = spec
+        self.breaker = breaker
+        self.client: Optional[VerifydClient] = None  # bound by the router
+        self.draining = False
+        #: last prober observation (None = not yet probed; routable)
+        self.up: Optional[bool] = None
+        self.in_flight = 0
+        self.last_retry_after = 0.0
+        self.last_error = ""
+
+    @property
+    def name(self) -> str:
+        return self.spec.name
+
+    def routable(self) -> bool:
+        """In the candidate set (breaker admission is checked at attempt
+        time — ``allow()`` consumes the half-open probe slot)."""
+        return not self.draining and self.up is not False
+
+
+@dataclass
+class RouterConfig:
+    #: router listen address: unix-socket path, or HOST:PORT (needs secret)
+    listen: str
+    #: fleet members, in declaration order
+    backends: Tuple[BackendSpec, ...]
+    #: shared secret: signs the router's own TCP listener frames *and*
+    #: every router→backend TCP exchange (unix backends need none)
+    secret: Optional[bytes] = None
+    probe_interval_s: float = 1.0
+    #: consecutive request failures before a backend's breaker opens
+    breaker_failures: int = 3
+    #: seconds an open breaker waits before admitting a half-open probe
+    breaker_reset_s: float = 5.0
+    #: router-side in-flight on the home node at/above which a cold job
+    #: is stolen to the least-loaded healthy node
+    steal_depth: int = 4
+    #: failover hops after the first attempt (bounded, per submit)
+    max_failovers: int = 3
+    #: per-attempt verdict wait against a backend (None = wait)
+    submit_timeout_s: Optional[float] = None
+    ring_replicas: int = 64
+    #: drain default: seconds to wait for in-flight before shutdown
+    drain_timeout_s: float = 30.0
+    #: router-side read-through verdict cache (entries; 0 disables).
+    #: Verdicts are immutable per fingerprint — the same invariant the
+    #: backends' own durable VerdictCache rests on — so the router may
+    #: answer an exact duplicate directly, with zero backend hops and
+    #: without even re-preparing the history (a raw-text digest memo
+    #: maps duplicate bytes straight to their fingerprint).  Survives
+    #: any backend dying; decided verdicts keep answering
+    cache_capacity: int = 4096
+    #: concurrent routed submits (each holds one executor thread while
+    #: the backend decides); excess connections queue on the executor
+    io_workers: int = 16
+    metrics_port: Optional[int] = None
+    trace_capacity: int = 4096
+    slo_target: float = 0.99
+    slo_latency_target_s: float = 5.0
+    frame_max_bytes: int = MAX_FRAME_BYTES
+    conn_deadline_s: float = 30.0
+    extra: dict = field(default_factory=dict)
+
+
+class VerifydRouter:
+    """The router daemon.  ``with VerifydRouter(cfg) as r: ...`` in
+    tests; :meth:`serve_forever` under ``route serve``."""
+
+    def __init__(self, config: RouterConfig) -> None:
+        if not config.backends:
+            raise ValueError("a router needs at least one --backend")
+        names = [b.name for b in config.backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate backend names: {names}")
+        self.cfg = config
+        self._is_tcp_listener = (
+            ":" in config.listen and not config.listen.startswith(("/", "."))
+        )
+        if self._is_tcp_listener and not config.secret:
+            raise ValueError("a TCP listener requires a shared secret")
+        self.registry = MetricsRegistry()
+        self.tracer = Tracer(config.trace_capacity)
+        self.tracer.name_track(0, "router")
+        self.health = SLOHealth(
+            SLOConfig(
+                availability_target=config.slo_target,
+                latency_target_s=config.slo_latency_target_s,
+            ),
+            registry=self.registry,
+        )
+        self.ring = HashRing(names, replicas=config.ring_replicas)
+        self._backends: Dict[str, _Backend] = {}
+        for spec in config.backends:
+            b = _Backend(
+                spec,
+                CircuitBreaker(
+                    failures=config.breaker_failures,
+                    reset_s=config.breaker_reset_s,
+                ),
+            )
+            b.client = self._make_client(spec.address)
+            self._backends[spec.name] = b
+        self._lock = threading.Lock()  # in-flight counters + steal choice
+        self._seq = itertools.count(1)
+        # Read-through edge cache (see RouterConfig.cache_capacity):
+        # raw-text digest -> fingerprint (skips prepare on duplicates),
+        # fingerprint -> decided reply payload (skips the backend hop).
+        self._cache_lock = threading.Lock()
+        self._text_fp: "OrderedDict[bytes, str]" = OrderedDict()
+        self._verdicts: "OrderedDict[str, dict]" = OrderedDict()
+
+        r = self.registry
+        lbl = ("backend",)
+        self._m_up = r.gauge(
+            "verifyd_router_backend_up",
+            "1 when the backend's last health probe succeeded",
+            labelnames=lbl,
+        )
+        self._m_breaker = r.gauge(
+            "verifyd_router_breaker_state",
+            "Circuit-breaker state per backend: 0 closed, 1 half-open, 2 open",
+            labelnames=lbl,
+        )
+        self._m_inflight = r.gauge(
+            "verifyd_router_backend_inflight",
+            "Routed submits currently awaiting a verdict on this backend",
+            labelnames=lbl,
+        )
+        self._m_draining = r.gauge(
+            "verifyd_router_backend_draining",
+            "1 while the backend is drained out of the routable set",
+            labelnames=lbl,
+        )
+        self._m_routed = r.counter(
+            "verifyd_router_routed_total",
+            "Submits answered by this backend",
+            labelnames=lbl,
+        )
+        self._m_stolen = r.counter(
+            "verifyd_router_stolen_total",
+            "Submits work-stolen *to* this backend from a saturated home",
+            labelnames=lbl,
+        )
+        self._m_failovers = r.counter(
+            "verifyd_router_failovers_total",
+            "Transport failures on this backend that failed over elsewhere",
+            labelnames=lbl,
+        )
+        self._m_busy = r.counter(
+            "verifyd_router_backend_busy_total",
+            "QueueFull answers from this backend (steal trigger)",
+            labelnames=lbl,
+        )
+        self._m_latency = r.histogram(
+            "verifyd_router_backend_seconds",
+            "Routed submit wall time (router-observed) per backend",
+            buckets=LATENCY_BUCKETS,
+            labelnames=lbl,
+        )
+        self._m_jobs = r.counter(
+            "verifyd_router_jobs_total", "Submit requests the router received"
+        )
+        self._m_no_backend = r.counter(
+            "verifyd_router_no_backend_total",
+            "Submits that exhausted every routable backend",
+        )
+        self._m_decode = r.counter(
+            "verifyd_router_decode_errors_total",
+            "Submits refused at the router with undecodable histories",
+        )
+        self._m_cache_hits = r.counter(
+            "verifyd_router_cache_hits_total",
+            "Duplicate submits answered from the router's edge cache",
+        )
+        for name in names:
+            self._m_up.set(0, backend=name)
+            self._m_breaker.set(0, backend=name)
+            self._m_inflight.set(0, backend=name)
+            self._m_draining.set(0, backend=name)
+            self._m_routed.inc(0, backend=name)
+            self._m_stolen.inc(0, backend=name)
+            self._m_failovers.inc(0, backend=name)
+
+        self.prober = HealthProber(
+            {
+                name: self._make_probe(b)
+                for name, b in self._backends.items()
+            },
+            interval_s=config.probe_interval_s,
+            on_change=self._on_probe_change,
+        )
+        self._counters = {
+            "routed": 0,
+            "stolen": 0,
+            "failovers": 0,
+            "busy": 0,
+            "no_backend": 0,
+            "decode_errors": 0,
+            "drains": 0,
+            "cache_hits": 0,
+        }
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, config.io_workers),
+            thread_name_prefix="router-io",
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._stopped = threading.Event()
+        self._stop: Optional[asyncio.Future] = None
+        self._startup_error: Optional[BaseException] = None
+        self.tcp_port: Optional[int] = None
+        self.metrics_port: Optional[int] = None
+        self._metrics_server: Optional[MetricsServer] = None
+        self._t0 = time.time()
+
+    # -- wiring --------------------------------------------------------------
+
+    def _make_client(self, address: str) -> VerifydClient:
+        if not address.startswith(("/", ".")) and ":" in address:
+            if not self.cfg.secret:
+                raise ValueError(
+                    f"TCP backend {address} requires the shared secret"
+                )
+            return VerifydClient(address, secret=self.cfg.secret)
+        return VerifydClient(address)
+
+    def _make_probe(self, b: _Backend):
+        if b.spec.healthz_url:
+            url = b.spec.healthz_url
+            return lambda: http_health_probe(url, timeout=2.0)
+
+        def _ping() -> bool:
+            try:
+                b.client.ping(timeout=2.0)
+                return True
+            except (VerifydError, OSError):
+                return False
+
+        return _ping
+
+    def _on_probe_change(self, name: str, up: bool) -> None:
+        b = self._backends[name]
+        was = b.up
+        b.up = up
+        self._m_up.set(1 if up else 0, backend=name)
+        if up and was is False:
+            # Rejoin after restart/drain: the journal replayed, the node
+            # answers again — re-absorb it into the ring with a clean
+            # breaker and no lingering drain flag.
+            b.draining = False
+            b.breaker.reset()
+            self._m_draining.set(0, backend=name)
+            log.info("backend %s rejoined the fleet", name)
+        elif not up:
+            log.warning("backend %s is down (probe failed)", name)
+        self._refresh_breaker_gauge(b)
+
+    def _refresh_breaker_gauge(self, b: _Backend) -> None:
+        state = {"closed": 0, "half_open": 1, "open": 2}[b.breaker.state]
+        self._m_breaker.set(state, backend=b.name)
+
+    # -- lifecycle (same shape as daemon.Verifyd) ----------------------------
+
+    def __enter__(self) -> "VerifydRouter":
+        if self.cfg.metrics_port is not None:
+            self._metrics_server = MetricsServer(
+                self.registry, self.cfg.metrics_port, health=self.health
+            )
+            self.metrics_port = self._metrics_server.port
+        self.prober.probe_once()  # routable set is live before the first job
+        self.prober.start()
+        self._thread = threading.Thread(
+            target=self._run, name="router-accept", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RuntimeError(f"router failed to start on {self.cfg.listen}")
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"router failed to start on {self.cfg.listen}"
+            ) from self._startup_error
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.request_stop()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self.prober.close()
+        self._pool.shutdown(wait=False)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+        if not self._is_tcp_listener:
+            with contextlib.suppress(FileNotFoundError):
+                os.remove(self.cfg.listen)
+
+    def request_stop(self) -> None:
+        self._stopped.set()
+        if self._loop is not None and self._stop is not None:
+            def _finish() -> None:
+                if not self._stop.done():
+                    self._stop.set_result(None)
+
+            with contextlib.suppress(RuntimeError):
+                self._loop.call_soon_threadsafe(_finish)
+
+    def wait(self) -> None:
+        try:
+            self._stopped.wait()
+        except KeyboardInterrupt:
+            pass
+
+    def serve_forever(self) -> int:
+        with self:
+            log.info(
+                "verifyd-router listening on %s%s fronting %d backends (%s)",
+                self.cfg.listen,
+                f" (port {self.tcp_port})" if self.tcp_port else "",
+                len(self._backends),
+                ", ".join(sorted(self._backends)),
+            )
+            self.wait()
+        return 0
+
+    def _run(self) -> None:
+        try:
+            asyncio.run(self._main())
+        except BaseException as e:
+            self._startup_error = e
+        finally:
+            self._started.set()
+            self._stopped.set()
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = self._loop.create_future()
+        if self._is_tcp_listener:
+            host, port = parse_hostport(self.cfg.listen)
+            server = await asyncio.start_server(
+                functools.partial(
+                    self._handle,
+                    secret=self.cfg.secret,
+                    deadline_s=self.cfg.conn_deadline_s,
+                ),
+                host=host,
+                port=port,
+                limit=self.cfg.frame_max_bytes,
+            )
+            self.tcp_port = server.sockets[0].getsockname()[1]
+        else:
+            server = await asyncio.start_unix_server(
+                functools.partial(self._handle, secret=None, deadline_s=None),
+                path=self.cfg.listen,
+                limit=self.cfg.frame_max_bytes,
+            )
+        self._started.set()
+        try:
+            await self._stop
+        finally:
+            server.close()
+            await server.wait_closed()
+
+    # -- connection handling (protocol.py framing, as the daemon) ------------
+
+    async def _read_frame(
+        self, reader: asyncio.StreamReader, deadline_s: Optional[float]
+    ) -> Optional[bytes]:
+        fut = reader.readuntil(b"\n")
+        if deadline_s is not None:
+            fut = asyncio.wait_for(fut, timeout=deadline_s)
+        try:
+            return await fut
+        except asyncio.IncompleteReadError as e:
+            return e.partial or None
+
+    async def _handle(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        secret: Optional[bytes],
+        deadline_s: Optional[float],
+    ) -> None:
+        try:
+            while True:
+                try:
+                    line = await self._read_frame(reader, deadline_s)
+                except (asyncio.LimitOverrunError, ValueError):
+                    resp = err(
+                        ERR_TOO_LARGE,
+                        f"frame exceeds {self.cfg.frame_max_bytes} bytes",
+                    )
+                    await self._reply(writer, resp, secret)
+                    break
+                except asyncio.TimeoutError:
+                    break
+                if not line:
+                    break
+                close_after = False
+                try:
+                    req = decode_frame(line)
+                except ValueError as e:
+                    resp = err(ERR_FRAME, f"malformed frame: {e}")
+                else:
+                    if secret is not None and not verify_frame(req, secret):
+                        resp = err(ERR_AUTH, "missing or invalid frame auth")
+                        close_after = True
+                    else:
+                        resp = await self._dispatch(req)
+                await self._reply(writer, resp, secret)
+                if close_after:
+                    break
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            writer.close()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
+
+    async def _reply(
+        self, writer: asyncio.StreamWriter, resp: dict, secret: Optional[bytes]
+    ) -> None:
+        if secret is not None:
+            resp = sign_frame(resp, secret)
+        writer.write(encode_frame(resp))
+        await writer.drain()
+
+    async def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        try:
+            if op == "ping":
+                return ok(
+                    {
+                        "server": "verifyd-router",
+                        "version": _version.__version__,
+                        "protocol": PROTOCOL_VERSION,
+                        "pid": os.getpid(),
+                        "backends": len(self._backends),
+                    }
+                )
+            if op == "stats":
+                return ok(self.snapshot())
+            if op == "fleet":
+                return ok(self.fleet_snapshot())
+            if op == "trace":
+                return ok(
+                    await self._loop.run_in_executor(
+                        self._pool, self.stitched_trace
+                    )
+                )
+            if op == "drain":
+                return await self._loop.run_in_executor(
+                    self._pool,
+                    functools.partial(
+                        self._drain_node,
+                        str(req.get("node") or ""),
+                        req.get("timeout"),
+                    ),
+                )
+            if op == "undrain":
+                return self._undrain_node(str(req.get("node") or ""))
+            if op == "shutdown":
+                self.request_stop()
+                return ok({"stopping": True})
+            if op == "submit":
+                # Edge-cache fast path: an exact duplicate of a decided
+                # history is answered on the loop thread — no executor
+                # hop, no prepare, no backend round-trip.
+                fast = self._cached_submit(req)
+                if fast is not None:
+                    return fast
+                return await self._loop.run_in_executor(
+                    self._pool, functools.partial(self._route_submit, req)
+                )
+            return err(ERR_DECODE, f"unknown op {op!r}")
+        except Exception as e:  # handler must never kill the loop
+            log.exception("router dispatch failed for op %r", op)
+            return err(ERR_INTERNAL, repr(e))
+
+    # -- edge cache ----------------------------------------------------------
+
+    @staticmethod
+    def _text_key(text: str) -> bytes:
+        return hashlib.blake2b(text.encode("utf-8"), digest_size=16).digest()
+
+    def _cached_submit(self, req: dict) -> Optional[dict]:
+        """Answer an exact-duplicate submit from the edge cache, or None.
+
+        Sound because verdicts are immutable per fingerprint (the same
+        invariant the backends' durable VerdictCache rests on) and the
+        cache only ever holds *decided* replies — inconclusive runs
+        always travel to a backend for a fresh attempt.
+        """
+        if self.cfg.cache_capacity <= 0:
+            return None
+        text = req.get("history")
+        if not isinstance(text, str) or not text:
+            return None
+        with self._cache_lock:
+            fp = self._text_fp.get(self._text_key(text))
+            payload = self._verdicts.get(fp) if fp is not None else None
+            if payload is None:
+                return None
+            self._verdicts.move_to_end(fp)
+            reply = dict(payload)
+        self._m_jobs.inc()
+        self._m_cache_hits.inc()
+        self._bump("cache_hits")
+        trace_id, _ = parse_trace_frame(req.get(TRACE_FIELD))
+        reply["cached"] = True
+        reply["router_cached"] = True
+        if trace_id is not None:
+            reply["trace_id"] = trace_id
+        self.health.observe_event({"ev": "cache_hit", "queue_wait_s": 0.0})
+        return ok(reply)
+
+    def _cache_store(self, key: bytes, fingerprint: str, reply: dict) -> None:
+        """Remember a decided reply (daemon rule: unknowns are never
+        cached — a resubmission deserves a fresh run)."""
+        cap = self.cfg.cache_capacity
+        if cap <= 0:
+            return
+        if reply.get("verdict") not in (0, 1):
+            return
+        keep = {
+            k: v
+            for k, v in reply.items()
+            if k not in ("trace_id", "queue_wait_s", "stolen")
+        }
+        with self._cache_lock:
+            self._text_fp[key] = fingerprint
+            self._text_fp.move_to_end(key)
+            while len(self._text_fp) > cap:
+                self._text_fp.popitem(last=False)
+            self._verdicts[fingerprint] = keep
+            self._verdicts.move_to_end(fingerprint)
+            while len(self._verdicts) > cap:
+                self._verdicts.popitem(last=False)
+
+    # -- routing core (runs on the executor, blocking clients) ---------------
+
+    def _candidate_order(self, fingerprint: str) -> Tuple[List[_Backend], bool]:
+        """(ordered attempt list, stolen?) for one job.
+
+        Ring preference first; when the home node is saturated, the
+        least-loaded routable node is promoted to the front (bounded
+        work-stealing — affinity is a latency optimization, never worth
+        queueing a cold job behind a hot shard).
+        """
+        prefs = [
+            self._backends[n]
+            for n in self.ring.preference(fingerprint)
+            if n in self._backends
+        ]
+        order = [b for b in prefs if b.routable()]
+        if not order:
+            return [], False
+        stolen = False
+        home = order[0]
+        with self._lock:
+            if len(order) > 1 and home.in_flight >= self.cfg.steal_depth:
+                lightest = min(order[1:], key=lambda b: b.in_flight)
+                if lightest.in_flight < home.in_flight:
+                    order.remove(lightest)
+                    order.insert(0, lightest)
+                    stolen = True
+        return order, stolen
+
+    def _route_submit(self, req: dict) -> dict:
+        t_recv = self.tracer.now()
+        self._m_jobs.inc()
+        trace_id, _sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(
+                ERR_DECODE, "submit needs a non-empty 'history' JSONL string"
+            )
+        # The router prepares the history itself: the fingerprint *is*
+        # the routing key (cache affinity), and an undecodable history
+        # is answered here — no backend burns a slot on it.  A text seen
+        # before (even one whose verdict wasn't cacheable) maps straight
+        # to its fingerprint without re-preparing.
+        text_key = self._text_key(text)
+        with self._cache_lock:
+            fingerprint = self._text_fp.get(text_key)
+        if fingerprint is None:
+            try:
+                hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+            except (ev.DecodeError, ValueError) as e:
+                self._bump("decode_errors")
+                self._m_decode.inc()
+                return err(ERR_DECODE, str(e))
+            fingerprint = history_fingerprint(hist)
+            if self.cfg.cache_capacity > 0:
+                with self._cache_lock:
+                    self._text_fp[text_key] = fingerprint
+                    while len(self._text_fp) > self.cfg.cache_capacity:
+                        self._text_fp.popitem(last=False)
+
+        order, stolen = self._candidate_order(fingerprint)
+        limit = 1 + max(0, self.cfg.max_failovers)
+        attempts = 0
+        last_busy: Optional[VerifydBusy] = None
+        last_err = "no routable backend"
+        seq = next(self._seq)
+        for b in order:
+            if attempts >= limit:
+                break
+            if not b.breaker.allow():
+                self._refresh_breaker_gauge(b)
+                continue
+            attempts += 1
+            with self._lock:
+                b.in_flight += 1
+                self._m_inflight.set(b.in_flight, backend=b.name)
+            t0 = self.tracer.now()
+            try:
+                reply = b.client.submit(
+                    text,
+                    client=str(req.get("client") or "router"),
+                    priority=int(req.get("priority") or 10),
+                    no_viz=req.get("no_viz"),
+                    timeout=self.cfg.submit_timeout_s,
+                    trace_id=trace_id,
+                )
+            except VerifydBusy as e:
+                # The node answered: alive, just saturated — steal the
+                # job onward and remember the hint for the client.
+                b.breaker.record_success()
+                b.last_retry_after = e.retry_after_s
+                last_busy = e
+                self._bump("busy")
+                self._m_busy.inc(backend=b.name)
+                continue
+            except (VerifydUnavailable, VerifydRefused) as e:
+                b.breaker.record_failure()
+                b.last_error = f"{e.cls}: {e.msg}"[:200]
+                self._refresh_breaker_gauge(b)
+                self._bump("failovers")
+                self._m_failovers.inc(backend=b.name)
+                last_err = b.last_error
+                self.tracer.add_span(
+                    "failover",
+                    t0,
+                    self.tracer.now(),
+                    tid=seq,
+                    cat="router",
+                    args={"trace_id": trace_id, "node": b.name, "error": e.cls},
+                )
+                continue
+            except VerifydError as e:
+                # A semantic answer (DecodeError, InternalError,
+                # ShuttingDown): the daemon decided — pass it through.
+                b.breaker.record_success()
+                if e.cls == ERR_SHUTTING_DOWN:
+                    # Draining underneath us: keep it out of the set
+                    # until the prober sees the restart.
+                    b.draining = True
+                    self._m_draining.set(1, backend=b.name)
+                    last_err = f"{e.cls}: {e.msg}"[:200]
+                    continue
+                self.health.observe_event({"ev": "job_error"})
+                return err(e.cls, e.msg, **{
+                    k: v
+                    for k, v in e.extra.items()
+                    if k not in ("class", "msg")
+                })
+            finally:
+                with self._lock:
+                    b.in_flight = max(0, b.in_flight - 1)
+                    self._m_inflight.set(b.in_flight, backend=b.name)
+
+            t1 = self.tracer.now()
+            dt = t1 - t0
+            b.breaker.record_success()
+            self._refresh_breaker_gauge(b)
+            self._bump("routed")
+            if stolen and attempts == 1:
+                self._bump("stolen")
+                self._m_stolen.inc(backend=b.name)
+            self._m_routed.inc(backend=b.name)
+            self._m_latency.observe(dt, exemplar=trace_id, backend=b.name)
+            self.health.observe_event(
+                {"ev": "done", "wall_s": dt, "queue_wait_s": 0.0}
+            )
+            if self.tracer.enabled:
+                self.tracer.name_track(seq, f"route {seq}")
+                self.tracer.add_span(
+                    "route",
+                    t_recv,
+                    t1,
+                    tid=seq,
+                    cat="router",
+                    args={
+                        "trace_id": trace_id,
+                        "node": b.name,
+                        "fingerprint": fingerprint,
+                        "attempts": attempts,
+                        "stolen": stolen and attempts == 1,
+                        "cached": bool(reply.get("cached")),
+                    },
+                )
+            reply["node"] = b.name
+            reply.setdefault("trace_id", trace_id)
+            if stolen and attempts == 1:
+                reply["stolen"] = True
+            self._cache_store(text_key, fingerprint, reply)
+            return ok(reply)
+
+        if last_busy is not None:
+            # Every routable node is saturated: propagate backpressure
+            # with the smallest live hint so clients sleep the minimum.
+            hints = [
+                b.last_retry_after
+                for b in order
+                if b.last_retry_after > 0
+            ] or [last_busy.retry_after_s]
+            self.health.observe_event({"ev": "reject"})
+            return err(
+                ERR_QUEUE_FULL,
+                f"all {attempts} routable backends at capacity",
+                retry_after_s=min(hints),
+            )
+        self._bump("no_backend")
+        self._m_no_backend.inc()
+        self.health.observe_event({"ev": "job_error"})
+        return err(
+            ERR_NO_BACKEND,
+            f"no backend answered after {attempts} attempts ({last_err})",
+            attempts=attempts,
+        )
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self._counters[key] += 1
+
+    # -- drain / rolling restart --------------------------------------------
+
+    def _drain_node(self, name: str, timeout: Any) -> dict:
+        b = self._backends.get(name)
+        if b is None:
+            return err(
+                ERR_DECODE,
+                f"unknown node {name!r} (fleet: {sorted(self._backends)})",
+            )
+        try:
+            timeout_s = (
+                float(timeout) if timeout is not None else self.cfg.drain_timeout_s
+            )
+        except (TypeError, ValueError):
+            return err(ERR_DECODE, "timeout must be a number")
+        b.draining = True
+        self._m_draining.set(1, backend=name)
+        self._bump("drains")
+        t0 = time.monotonic()
+        # Step 1: stop routing (done), wait for the router's in-flight
+        # on this node to clear.
+        while time.monotonic() - t0 < timeout_s and b.in_flight > 0:
+            time.sleep(0.05)
+        waited_s = round(time.monotonic() - t0, 3)
+        # Step 2: drain-aware shutdown — the backend stops admitting,
+        # finishes its own in-flight up to its deadline, and closes the
+        # journal cleanly (serve --drain-timeout).
+        shutdown: Any
+        try:
+            shutdown = b.client.shutdown(
+                timeout=10.0, drain=True, drain_timeout_s=timeout_s
+            )
+        except (VerifydError, OSError) as e:
+            shutdown = {"error": str(e)[:200]}
+        log.info(
+            "drained %s in %.2fs (in_flight clear: %s)",
+            name,
+            waited_s,
+            b.in_flight == 0,
+        )
+        return ok(
+            {
+                "node": name,
+                "drained": b.in_flight == 0,
+                "waited_s": waited_s,
+                "shutdown": shutdown,
+            }
+        )
+
+    def _undrain_node(self, name: str) -> dict:
+        b = self._backends.get(name)
+        if b is None:
+            return err(
+                ERR_DECODE,
+                f"unknown node {name!r} (fleet: {sorted(self._backends)})",
+            )
+        b.draining = False
+        b.breaker.reset()
+        self._m_draining.set(0, backend=name)
+        self._refresh_breaker_gauge(b)
+        return ok({"node": name, "draining": False})
+
+    # -- introspection -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            snap: Dict[str, Any] = dict(self._counters)
+        snap["uptime_s"] = round(time.time() - self._t0, 3)
+        snap["backends"] = {
+            name: {
+                "up": b.up,
+                "draining": b.draining,
+                "breaker": b.breaker.state,
+                "in_flight": b.in_flight,
+            }
+            for name, b in sorted(self._backends.items())
+        }
+        if self.metrics_port is not None:
+            snap["metrics_port"] = self.metrics_port
+        snap["metrics"] = self.registry.snapshot()
+        snap["slo"] = self.health.snapshot()
+        return snap
+
+    def fleet_snapshot(self) -> dict:
+        return {
+            "ring": {
+                "replicas": self.cfg.ring_replicas,
+                "nodes": self.ring.nodes(),
+            },
+            "backends": [
+                {
+                    "name": b.name,
+                    "address": b.spec.address,
+                    "healthz": b.spec.healthz_url,
+                    "up": b.up,
+                    "draining": b.draining,
+                    "breaker": b.breaker.state,
+                    "in_flight": b.in_flight,
+                    "last_error": b.last_error or None,
+                }
+                for b in (
+                    self._backends[n] for n in sorted(self._backends)
+                )
+            ],
+        }
+
+    def stitched_trace(self) -> dict:
+        """One Perfetto-loadable export spanning all three tiers.
+
+        The router's own ring, plus every reachable backend's ring
+        (which already contains the merged supervised-child spans),
+        timestamp-shifted via the ``wall_base`` clock-offset handshake
+        and pid-remapped per node so Perfetto renders one process group
+        per tier.
+        """
+        base = self.tracer.export()
+        events: List[dict] = list(base["traceEvents"])
+        merged = []
+        for i, name in enumerate(sorted(self._backends)):
+            b = self._backends[name]
+            try:
+                bx = b.client.trace(timeout=10.0)
+            except (VerifydError, OSError):
+                continue
+            try:
+                wall = float(bx.get("otherData", {}).get("wall_base"))
+            except (TypeError, ValueError):
+                wall = self.tracer.wall_base
+            offset_us = (wall - self.tracer.wall_base) * 1e6
+            pid = 1000 + i
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "ts": 0,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {"name": f"verifyd[{name}]"},
+                }
+            )
+            for e in bx.get("traceEvents", ()):
+                if not isinstance(e, dict):
+                    continue
+                e2 = dict(e)
+                e2["pid"] = pid
+                if e.get("ph") == "X":
+                    try:
+                        e2["ts"] = round(float(e.get("ts", 0.0)) + offset_us, 3)
+                    except (TypeError, ValueError):
+                        continue
+                events.append(e2)
+            merged.append(name)
+        out = dict(base)
+        out["traceEvents"] = events
+        other = dict(out.get("otherData") or {})
+        other["router_backends"] = merged
+        out["otherData"] = other
+        return out

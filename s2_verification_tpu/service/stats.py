@@ -491,6 +491,12 @@ class ServiceStats:
             snap["archive"] = self.archive.snapshot()
         return snap
 
+    @property
+    def active(self) -> int:
+        """Jobs handed to a worker and not yet answered (drain poller)."""
+        with self._lock:
+            return self._active
+
     def retry_after_hint(self, queue_depth: int) -> float:
         """Backpressure hint: roughly how long until the queue has room —
         (queued + in-flight jobs) × average decided-job wall time, clamped

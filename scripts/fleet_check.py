@@ -1,0 +1,525 @@
+"""Fleet gate: prove the router tier makes node failure a non-event.
+
+Topology under test: 2 verifyd backends (separate processes, durable
+``--state-dir``, authenticated TCP transport, ``--drain-timeout`` set,
+HTTP ``/healthz`` probed) behind one in-process ``VerifydRouter``.
+
+Scenario, in order, all against one-shot ``check`` ground truth:
+
+1. **Warm-up parity** — the corpus routed through the router answers
+   with one-shot verdicts; duplicate resubmission hits the home node's
+   verdict cache (consistent-hash affinity).
+2. **SIGKILL mid-load** — loader threads push duplicate-heavy traffic
+   through the router while one backend is SIGKILLed.  Assertions:
+   zero lost accepted jobs (every submission gets a verdict), verdict
+   parity throughout, the router's own ``/healthz`` stays 200 for the
+   whole window (single-node kill never breaches the router SLO), and
+   the fleet view marks the victim down.
+3. **Rejoin** — the victim restarts on the same state dir (journal
+   replay), the prober re-absorbs it, and ring affinity routes its
+   histories back to it.
+4. **Rolling drain** — ``drain`` on the surviving original node: its
+   process exits 0 (clean drain-aware shutdown), and the router keeps
+   answering the full corpus on the remaining node.
+
+Exit 0 when every assertion holds; 1 with failures on stderr.  One JSON
+summary line lands on stdout.  ``make fleet`` runs this; ``make
+chaos-full`` includes it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from chaos_bench import _render, build_corpus, one_shot_verdicts  # noqa: E402
+from helpers import H, fold  # noqa: E402
+
+from s2_verification_tpu.checker.entries import prepare  # noqa: E402
+from s2_verification_tpu.service.cache import history_fingerprint  # noqa: E402
+from s2_verification_tpu.service.client import (  # noqa: E402
+    VerifydClient,
+    VerifydError,
+)
+from s2_verification_tpu.service.router import (  # noqa: E402
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev  # noqa: E402
+
+SECRET = b"fleet-check-shared-secret"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_backend(
+    name: str, tmp: str, tcp_port: int, metrics_port: int
+) -> subprocess.Popen:
+    sock = os.path.join(tmp, f"{name}.sock")
+    if os.path.exists(sock):
+        os.remove(sock)  # SIGKILL leaves the socket file; serve refuses it
+    secret_file = os.path.join(tmp, "secret")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "s2_verification_tpu",
+            "serve",
+            "-socket",
+            sock,
+            "--workers",
+            "1",
+            "--device",
+            "off",
+            "-no-viz",
+            "--tcp",
+            f"127.0.0.1:{tcp_port}",
+            "--secret-file",
+            secret_file,
+            "--state-dir",
+            os.path.join(tmp, f"state-{name}"),
+            "--metrics-port",
+            str(metrics_port),
+            "--drain-timeout",
+            "15",
+            "--stats-log",
+            "",
+            "-out-dir",
+            os.path.join(tmp, "viz"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    probe = VerifydClient(f"127.0.0.1:{tcp_port}", secret=SECRET)
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"backend {name} exited rc={proc.returncode} before binding"
+            )
+        try:
+            probe.ping(timeout=1.0)
+            return proc
+        except (VerifydError, OSError):
+            pass
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"backend {name} never answered ping")
+        time.sleep(0.1)
+
+
+def _fresh_homed(router: VerifydRouter, target: str, count: int, base: int):
+    """``count`` fresh linearizable histories whose ring home is ``target``.
+
+    Fresh (never-submitted) texts bypass the router's edge cache, so
+    submitting them proves live routing decisions — rejoin re-absorption
+    and drain avoidance — rather than replaying cached provenance.  The
+    home is computed with the router's own ring, so the pick is exact.
+    """
+    out = []
+    while len(out) < count:
+        base += 1000
+        h = H()
+        h.append_ok(1, [base + 1], tail=1)
+        h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+        text = _render(h)
+        hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+        if router.ring.preference(history_fingerprint(hist))[0] == target:
+            out.append((f"fresh-{target}-{base}", text))
+    return out, base
+
+
+def _cold_corpus(n: int, base0: int):
+    """``chaos_bench.build_corpus`` with a base offset: fresh
+    fingerprints the fleet has never seen, so the kill window carries
+    genuinely *routed* load (cache hits alone can't answer it) and the
+    SIGKILL provably exercises failover.  Returns (name, text,
+    expected_verdict) — the good/bad pattern is the ground truth."""
+    out = []
+    for i in range(n):
+        base = base0 + 1000 * (i + 1)
+        h = H()
+        if i % 2 == 0:
+            h.append_ok(1, [base + 1], tail=1)
+            h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+            h.append_ok(2, [base + 2, base + 3], tail=3)
+            h.read_ok(
+                1, tail=3, stream_hash=fold([base + 1, base + 2, base + 3])
+            )
+            out.append((f"cold-good{i}", _render(h), 0))
+        else:
+            h.append_ok(1, [base + 1], tail=1)
+            h.read_ok(2, tail=1, stream_hash=base)  # impossible stream hash
+            out.append((f"cold-bad{i}", _render(h), 1))
+    return out
+
+
+def _healthz_code(port: int) -> int:
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=2.0
+        ) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except OSError:
+        return -1
+
+
+class _Loader(threading.Thread):
+    """Push (name, text) jobs through the router, recording verdicts."""
+
+    def __init__(self, client_addr: str, jobs, results, failures, label):
+        super().__init__(daemon=True)
+        self.client = VerifydClient(client_addr)
+        self.jobs = jobs
+        self.results = results
+        self.failures = failures
+        self.label = label
+
+    def run(self) -> None:
+        for name, text in self.jobs:
+            try:
+                reply = self.client.submit_with_retry(
+                    text,
+                    client=self.label,
+                    retries=10,
+                    backoff_s=0.05,
+                    no_viz=True,
+                    timeout=120,
+                )
+            except VerifydError as e:
+                self.failures.append(f"{self.label}: {name} lost ({e})")
+                continue
+            self.results.append((name, reply))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--histories", type=int, default=6, help="corpus size (default 6)"
+    )
+    ap.add_argument(
+        "--load-repeats",
+        type=int,
+        default=4,
+        help="duplicate-heavy load: corpus repetitions per loader thread "
+        "during the kill window (default 4)",
+    )
+    args = ap.parse_args()
+
+    corpus = build_corpus(args.histories)
+    workdir = tempfile.mkdtemp(prefix="fleet-corpus-")
+    tmp = tempfile.mkdtemp(prefix="fleet-")
+    failures: list[str] = []
+    summary: dict = {}
+    procs: dict[str, subprocess.Popen] = {}
+    t0 = time.monotonic()
+    try:
+        expect = one_shot_verdicts(corpus, workdir)
+        print(f"# one-shot ground truth: {expect}", file=sys.stderr)
+
+        with open(os.path.join(tmp, "secret"), "wb") as f:
+            f.write(SECRET)
+        ports = {n: _free_port() for n in ("a", "b")}
+        mports = {n: _free_port() for n in ("a", "b")}
+        for n in ("a", "b"):
+            procs[n] = _spawn_backend(n, tmp, ports[n], mports[n])
+        print(
+            f"# backends up: a=127.0.0.1:{ports['a']} b=127.0.0.1:{ports['b']}",
+            file=sys.stderr,
+        )
+
+        listen = os.path.join(tmp, "router.sock")
+        cfg = RouterConfig(
+            listen=listen,
+            backends=tuple(
+                BackendSpec(
+                    n,
+                    f"127.0.0.1:{ports[n]}",
+                    f"http://127.0.0.1:{mports[n]}/healthz",
+                )
+                for n in ("a", "b")
+            ),
+            secret=SECRET,
+            probe_interval_s=0.3,
+            breaker_failures=2,
+            breaker_reset_s=1.0,
+            metrics_port=0,
+        )
+        with VerifydRouter(cfg) as router:
+            client = VerifydClient(listen)
+
+            # Phase 1: warm-up parity + cache affinity.
+            homes: dict[str, str] = {}
+            for name, text in corpus:
+                reply = client.submit(text, client="fleet-warm", no_viz=True)
+                homes[name] = reply.get("node")
+                if reply.get("verdict") != expect[name]:
+                    failures.append(
+                        f"warm: {name} verdict {reply.get('verdict')} != "
+                        f"one-shot {expect[name]}"
+                    )
+            for name, text in corpus:
+                reply = client.submit(text, client="fleet-warm2", no_viz=True)
+                if not reply.get("cached"):
+                    failures.append(f"warm: duplicate {name} missed the cache")
+                if reply.get("node") != homes[name]:
+                    failures.append(
+                        f"warm: {name} re-routed {homes[name]} → "
+                        f"{reply.get('node')} (affinity broken)"
+                    )
+            summary["homes"] = dict(sorted(homes.items()))
+            victim = homes[corpus[0][0]] or "a"
+            survivor = "b" if victim == "a" else "a"
+            print(
+                f"# warm parity ok; victim={victim} survivor={survivor}",
+                file=sys.stderr,
+            )
+
+            # Phase 2: SIGKILL the victim mid-load; /healthz green
+            # throughout; zero lost jobs; parity.
+            dup_jobs = [
+                (f"{name}@{r}", text)
+                for r in range(args.load_repeats)
+                for name, text in corpus
+            ]
+            # Half again as many cold histories, interleaved: duplicate
+            # traffic proves the edge cache survives the kill; cold
+            # traffic proves live routing fails over around it.
+            cold = _cold_corpus(max(2, len(dup_jobs) // 2), 200_000)
+            expect.update({name: v for name, _, v in cold})
+            jobs = []
+            ci = 0
+            for i, j in enumerate(dup_jobs):
+                jobs.append(j)
+                if i % 2 == 1 and ci < len(cold):
+                    name, text, _ = cold[ci]
+                    jobs.append((name, text))
+                    ci += 1
+            jobs.extend((name, text) for name, text, _ in cold[ci:])
+            half = len(jobs) // 2
+            results: list = []
+            loaders = [
+                _Loader(listen, jobs[:half], results, failures, "fleet-kill-1"),
+                _Loader(listen, jobs[half:], results, failures, "fleet-kill-2"),
+            ]
+            health_codes: list[int] = []
+            stop_health = threading.Event()
+
+            def _health_loop() -> None:
+                while not stop_health.is_set():
+                    health_codes.append(_healthz_code(router.metrics_port))
+                    stop_health.wait(0.2)
+
+            health_thread = threading.Thread(target=_health_loop, daemon=True)
+            health_thread.start()
+            for ld in loaders:
+                ld.start()
+            # Genuinely mid-load: kill once a quarter of the stream has
+            # answered but well before the loaders finish.
+            kill_at = max(1, len(jobs) // 4)
+            wait_deadline = time.monotonic() + 30
+            while len(results) < kill_at and time.monotonic() < wait_deadline:
+                time.sleep(0.01)
+            os.kill(procs[victim].pid, signal.SIGKILL)
+            procs[victim].wait()
+            kill_t = time.monotonic()
+            print(
+                f"# SIGKILL {victim} mid-load ({len(results)}/{len(jobs)} "
+                "answered at kill)",
+                file=sys.stderr,
+            )
+            for ld in loaders:
+                ld.join(timeout=300)
+            stop_health.set()
+            health_thread.join(timeout=5)
+
+            if len(results) != len(jobs):
+                failures.append(
+                    f"kill: {len(jobs) - len(results)} of {len(jobs)} "
+                    "submissions lost during node kill"
+                )
+            for name, reply in results:
+                base = name.split("@")[0]
+                if reply.get("verdict") != expect[base]:
+                    failures.append(
+                        f"kill: {name} verdict {reply.get('verdict')} != "
+                        f"one-shot {expect[base]}"
+                    )
+            bad_health = [c for c in health_codes if c != 200]
+            if bad_health:
+                failures.append(
+                    f"kill: router /healthz left 200 during the kill window "
+                    f"({len(bad_health)}/{len(health_codes)} bad: "
+                    f"{sorted(set(bad_health))})"
+                )
+            # The prober may need a tick or two past the last verdict.
+            down_deadline = time.monotonic() + 10
+            while time.monotonic() < down_deadline:
+                fleet = client.fleet()
+                down = {b["name"]: b["up"] for b in fleet["backends"]}
+                if down.get(victim) is False:
+                    break
+                time.sleep(0.2)
+            if down.get(victim) is not False:
+                failures.append(
+                    f"kill: fleet still shows {victim} up={down.get(victim)}"
+                )
+            stats = client.stats()
+            summary["kill"] = {
+                "jobs": len(jobs),
+                "answered": len(results),
+                "healthz_checks": len(health_codes),
+                "failovers": stats["failovers"],
+                "stolen": stats["stolen"],
+                "routed": stats["routed"],
+            }
+            print(
+                f"# kill window: {len(results)}/{len(jobs)} answered, "
+                f"{stats['failovers']} failovers, "
+                f"{len(health_codes)} healthz checks all-200="
+                f"{not bad_health}",
+                file=sys.stderr,
+            )
+
+            # Phase 3: the victim rejoins — journal replay, prober
+            # up-edge, ring re-absorption (its histories route home).
+            procs[victim] = _spawn_backend(
+                victim, tmp, ports[victim], mports[victim]
+            )
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                fleet = client.fleet()
+                state = {b["name"]: b for b in fleet["backends"]}
+                if state[victim]["up"] and not state[victim]["draining"]:
+                    break
+                time.sleep(0.2)
+            else:
+                failures.append(f"rejoin: {victim} never re-absorbed")
+            rejoin_nodes = set()
+            for name, text in corpus:
+                reply = client.submit(text, client="fleet-rejoin", no_viz=True)
+                rejoin_nodes.add(reply.get("node"))
+                if reply.get("verdict") != expect[name]:
+                    failures.append(
+                        f"rejoin: {name} verdict {reply.get('verdict')} != "
+                        f"one-shot {expect[name]}"
+                    )
+            # Fresh histories homed at the victim bypass the router's
+            # edge cache: only a live ring decision can answer them.
+            fresh, fresh_base = _fresh_homed(router, victim, 3, 100_000)
+            for name, text in fresh:
+                reply = client.submit(text, client="fleet-rejoin", no_viz=True)
+                rejoin_nodes.add(reply.get("node"))
+                if reply.get("node") != victim:
+                    failures.append(
+                        f"rejoin: fresh {name} homed at {victim} routed to "
+                        f"{reply.get('node')} (ring never re-absorbed it)"
+                    )
+                if reply.get("verdict") != 0:
+                    failures.append(
+                        f"rejoin: fresh {name} verdict "
+                        f"{reply.get('verdict')}, want 0 (linearizable)"
+                    )
+            summary["rejoin"] = {
+                "wait_s": round(time.monotonic() - kill_t, 2),
+                "nodes": sorted(rejoin_nodes),
+            }
+            print(f"# rejoin ok: nodes={sorted(rejoin_nodes)}", file=sys.stderr)
+
+            # Phase 4: rolling drain of the survivor — clean exit,
+            # router keeps answering on the rejoined node.
+            drain = client.drain(survivor, drain_timeout_s=20.0, timeout=None)
+            if not drain.get("drained"):
+                failures.append(f"drain: {survivor} in-flight never cleared")
+            try:
+                rc = procs[survivor].wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                procs[survivor].kill()
+                rc = None
+            if rc != 0:
+                failures.append(
+                    f"drain: {survivor} exited rc={rc}, want 0 (clean drain)"
+                )
+            for name, text in corpus:
+                reply = client.submit(text, client="fleet-drain", no_viz=True)
+                if reply.get("verdict") != expect[name]:
+                    failures.append(
+                        f"drain: {name} verdict {reply.get('verdict')} != "
+                        f"one-shot {expect[name]}"
+                    )
+                # Edge-cached replies keep their original provenance;
+                # only a live routing decision can violate the drain.
+                if (
+                    reply.get("node") == survivor
+                    and not reply.get("router_cached")
+                ):
+                    failures.append(
+                        f"drain: {name} routed to drained node {survivor}"
+                    )
+            # Fresh histories homed at the *drained* node must route
+            # around it — the sharpest statement of drain correctness.
+            fresh, _ = _fresh_homed(router, survivor, 3, fresh_base)
+            for name, text in fresh:
+                reply = client.submit(text, client="fleet-drain", no_viz=True)
+                if reply.get("node") == survivor:
+                    failures.append(
+                        f"drain: fresh {name} routed to drained node "
+                        f"{survivor}"
+                    )
+                if reply.get("verdict") != 0:
+                    failures.append(
+                        f"drain: fresh {name} verdict "
+                        f"{reply.get('verdict')}, want 0 (linearizable)"
+                    )
+            summary["drain"] = {"survivor_rc": rc, **drain}
+            print(
+                f"# drain ok: {survivor} exited {rc}, fleet serving on "
+                f"{victim}",
+                file=sys.stderr,
+            )
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(workdir, ignore_errors=True)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["wall_s"] = round(time.monotonic() - t0, 2)
+    summary["failures"] = len(failures)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print(json.dumps({"fleet_check": summary}, sort_keys=True))
+    if failures:
+        return 1
+    print("# fleet_check: all assertions hold", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,7 +48,14 @@ What it checks (the `make obs` gate):
     child's compile activity must fold into the parent's stats op;
 14. resource timeline: a SIGKILLed daemon's state dir must yield a
     ``doctor`` report (exit 1: unclean) showing the resource timeline
-    sampled before death.
+    sampled before death;
+15. fleet: a router fronting two backends must expose every
+    ``verifyd_router_*`` family with per-backend label values bounded by
+    the configured fleet (no cardinality leaks), answer an exact
+    duplicate from its edge cache, and return ONE stitched trace export
+    in which a routed job's ``trace_id`` appears on the router's pid AND
+    a backend's remapped pid — router → daemon → supervised child on a
+    single Perfetto timeline.
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
 Pure stdlib + the package; runs on CPU in under a minute.
@@ -111,6 +118,21 @@ REQUIRED_RESOURCE_FAMILIES = (
     "verifyd_resource_cpu_seconds",
     "verifyd_resource_open_fds",
     "verifyd_resource_threads",
+)
+
+#: per-backend router families the fleet phase requires on the router's
+#: own /metrics listener (PR 9: the routing tier observes like a daemon)
+REQUIRED_ROUTER_FAMILIES = (
+    "verifyd_router_backend_up",
+    "verifyd_router_breaker_state",
+    "verifyd_router_backend_inflight",
+    "verifyd_router_backend_draining",
+    "verifyd_router_routed_total",
+    "verifyd_router_stolen_total",
+    "verifyd_router_failovers_total",
+    "verifyd_router_backend_seconds",
+    "verifyd_router_jobs_total",
+    "verifyd_router_cache_hits_total",
 )
 
 #: one OpenMetrics exemplar suffix: `` # {trace_id="<32 hex>"} <v> <ts>``
@@ -1016,6 +1038,180 @@ def main() -> int:
             return _fail(f"resource timeline rss never positive: {timeline}")
         doctor_samples = len(timeline)
 
+    # -- fleet phase: router metrics + one stitched 3-tier trace ------------
+    import contextlib
+
+    from s2_verification_tpu.service.router import (
+        BackendSpec,
+        RouterConfig,
+        VerifydRouter,
+    )
+
+    # Supervised backends with an impossible wall budget: every cold job
+    # escalates to a child process, so the backend rings carry
+    # child-origin spans for the stitch assertion.
+    sched_mod._cpu_check = lambda hist, budget, profile=False: (
+        CheckResult(CheckOutcome.UNKNOWN),
+        "native",
+    )
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-fleet-") as d, \
+                contextlib.ExitStack() as stack:
+            names = ("a", "b")
+            specs = []
+            for n in names:
+                bsock = os.path.join(d, f"{n}.sock")
+                stack.enter_context(
+                    Verifyd(
+                        VerifydConfig(
+                            socket_path=bsock,
+                            out_dir=os.path.join(d, f"viz-{n}"),
+                            no_viz=True,
+                            stats_log=None,
+                            device="supervised",
+                            time_budget_s=0.01,
+                            spool_dir=os.path.join(d, f"spool-{n}"),
+                            attempt_timeout_s=120,
+                        )
+                    )
+                )
+                specs.append(BackendSpec(n, bsock))
+            listen = os.path.join(d, "router.sock")
+            router = stack.enter_context(
+                VerifydRouter(
+                    RouterConfig(
+                        listen=listen,
+                        backends=tuple(specs),
+                        probe_interval_s=0.5,
+                        metrics_port=0,
+                    )
+                )
+            )
+            client = VerifydClient(listen)
+            routed = [
+                client.submit(texts[i], client="obs-fleet", timeout=180)
+                for i in range(2)
+            ]
+            for r in routed:
+                if r.get("verdict") not in (0, 1):
+                    return _fail(f"fleet: routed job failed: {r}")
+                if r.get("node") not in names:
+                    return _fail(f"fleet: reply names no backend: {r}")
+            dup = client.submit(texts[0], client="obs-fleet", timeout=180)
+            if not dup.get("router_cached"):
+                return _fail(
+                    f"fleet: exact duplicate missed the router edge "
+                    f"cache: {dup}"
+                )
+
+            body = (
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{router.metrics_port}/metrics",
+                    timeout=5,
+                )
+                .read()
+                .decode("utf-8")
+            )
+            kinds = _parse_families(body)
+            for fam in REQUIRED_ROUTER_FAMILIES:
+                if fam not in kinds:
+                    return _fail(
+                        f"fleet: family {fam} missing from the router's "
+                        f"/metrics (have: "
+                        f"{sorted(k for k in kinds if 'router' in k)})"
+                    )
+            if kinds["verifyd_router_backend_seconds"] != "histogram":
+                return _fail(
+                    "fleet: verifyd_router_backend_seconds is not a histogram"
+                )
+            # Bounded label cardinality: every backend label value on a
+            # router family names a configured fleet member, nothing else.
+            backend_labels = {
+                line.split('backend="', 1)[1].split('"', 1)[0]
+                for line in body.splitlines()
+                if line.startswith("verifyd_router") and 'backend="' in line
+            }
+            if not backend_labels:
+                return _fail("fleet: router families carry no backend label")
+            if not backend_labels <= set(names):
+                return _fail(
+                    f"fleet: backend label cardinality leaked past the "
+                    f"configured fleet: {sorted(backend_labels)}"
+                )
+            lat_series = _histogram_series(
+                body, "verifyd_router_backend_seconds"
+            )
+            for labels, s in lat_series.items():
+                ns = [n for _, n in s["buckets"]]
+                if ns != sorted(ns):
+                    return _fail(
+                        f"fleet: verifyd_router_backend_seconds{{{labels}}} "
+                        f"non-monotone buckets {ns}"
+                    )
+            hits_lines = [
+                line
+                for line in body.splitlines()
+                if line.startswith("verifyd_router_cache_hits_total ")
+            ]
+            if not hits_lines or float(
+                hits_lines[0].rsplit(" ", 1)[1]
+            ) < 1:
+                return _fail(
+                    f"fleet: router cache hit never counted: {hits_lines}"
+                )
+
+            # One stitched export, three tiers, one id: the routed job's
+            # trace_id must ride spans on the router's pid AND on a
+            # remapped backend pid whose ring holds child-origin spans.
+            tid = routed[0].get("trace_id")
+            if not tid:
+                return _fail(f"fleet: routed reply carries no trace_id")
+            stitched_export = client.trace()
+            json.dumps(stitched_export)  # must round-trip
+            sevents = stitched_export.get("traceEvents") or []
+            mine = [
+                e
+                for e in sevents
+                if e.get("ph") == "X"
+                and (e.get("args") or {}).get("trace_id") == tid
+            ]
+            fleet_pids = {e.get("pid") for e in mine}
+            if len(fleet_pids) < 2:
+                return _fail(
+                    f"fleet: trace {tid} confined to pids "
+                    f"{sorted(fleet_pids, key=str)} — stitch spans one tier"
+                )
+            if not any(e.get("name") == "route" for e in mine):
+                return _fail(
+                    f"fleet: no router `route` span under trace {tid}: "
+                    f"{sorted(e['name'] for e in mine)}"
+                )
+            fleet_origins = {
+                (e.get("args") or {}).get("origin") or "daemon"
+                for e in mine
+                if e.get("pid") in fleet_pids and e.get("pid", 0) >= 1000
+            }
+            if "child" not in fleet_origins:
+                return _fail(
+                    f"fleet: stitched trace {tid} carries no supervised-"
+                    f"child spans (origins: {sorted(fleet_origins)})"
+                )
+            pnames = {
+                (e.get("args") or {}).get("name")
+                for e in sevents
+                if e.get("ph") == "M" and e.get("name") == "process_name"
+            }
+            if not any(
+                isinstance(p, str) and p.startswith("verifyd[")
+                for p in pnames
+            ):
+                return _fail(
+                    f"fleet: no per-backend process_name metadata: "
+                    f"{sorted(pnames, key=str)}"
+                )
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
         f"{len(spans)} spans, {len(profiled)} profiled jobs, "
@@ -1030,7 +1226,10 @@ def main() -> int:
         f"{dash_points} sparkline points, {len(jit_sites)} jit site(s) "
         f"compiled under introspection (child fold "
         f"{pre_compiles}->{post_compiles}), doctor read {doctor_samples} "
-        f"resource sample(s) off a SIGKILLed daemon"
+        f"resource sample(s) off a SIGKILLed daemon, "
+        f"{len(REQUIRED_ROUTER_FAMILIES)} router families over "
+        f"{len(backend_labels)} backends with one trace stitched across "
+        f"{len(fleet_pids)} pids"
     )
     return 0
 

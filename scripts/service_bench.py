@@ -122,7 +122,18 @@ def main() -> int:
                     "to CPU) and report the mesh serving row "
                     "service_mesh_jobs_per_sec next to the published "
                     "service_jobs_per_sec baseline")
+    ap.add_argument("--fleet", type=int, default=None, metavar="N",
+                    help="spawn N verifyd backend *processes* behind an "
+                    "in-process router (consistent-hash cache affinity, "
+                    "work stealing) and drive the load through it; "
+                    "reports the fleet serving row "
+                    "service_fleet_jobs_per_sec vs the published "
+                    "single-daemon baseline")
     args = ap.parse_args()
+
+    if args.fleet is not None and (args.socket or args.mesh_devices):
+        print("# --fleet excludes --socket / --mesh-devices", file=sys.stderr)
+        return 64
 
     if args.mesh_devices is not None and not args.socket:
         # Provision the virtual topology before any jax use: inline
@@ -151,7 +162,66 @@ def main() -> int:
           f"{args.concurrency} submitters", file=sys.stderr)
 
     daemon_ctx = None
-    if args.socket:
+    router_ctx = None
+    fleet_procs: list = []
+    if args.fleet is not None:
+        import subprocess
+
+        from s2_verification_tpu.service.router import (
+            BackendSpec,
+            RouterConfig,
+            VerifydRouter,
+        )
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tmp = tempfile.mkdtemp(prefix="service-bench-fleet-")
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+        specs = []
+        for i in range(args.fleet):
+            bsock = os.path.join(tmp, f"backend{i}.sock")
+            fleet_procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m", "s2_verification_tpu", "serve",
+                        "-socket", bsock,
+                        "--workers", str(args.workers),
+                        "--queue-depth", str(args.queue_depth),
+                        "--device", "off",
+                        "-no-viz",
+                        "--stats-log", "",
+                        "-out-dir", os.path.join(tmp, "viz"),
+                    ],
+                    env=env,
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    cwd=tmp,
+                )
+            )
+            specs.append(BackendSpec(f"n{i}", bsock))
+        deadline = time.monotonic() + 120
+        for i, spec in enumerate(specs):
+            while not os.path.exists(spec.address):
+                if fleet_procs[i].poll() is not None:
+                    print(f"# backend {spec.name} died during startup",
+                          file=sys.stderr)
+                    return 1
+                if time.monotonic() > deadline:
+                    print(f"# backend {spec.name} never bound", file=sys.stderr)
+                    return 1
+                time.sleep(0.05)
+        sock = os.path.join(tmp, "router.sock")
+        router_ctx = VerifydRouter(
+            RouterConfig(
+                listen=sock,
+                backends=tuple(specs),
+                probe_interval_s=0.5,
+                metrics_port=args.metrics_port,
+            )
+        )
+        router_ctx.__enter__()
+        print(f"# fleet: {args.fleet} backend processes behind the router",
+              file=sys.stderr)
+    elif args.socket:
         sock = args.socket
         if args.metrics_port is not None or args.trace_out:
             print(
@@ -279,19 +349,26 @@ def main() -> int:
         value = round(done / wall, 2) if wall > 0 else 0.0
         baseline = _published_baseline()
         mesh = args.mesh_devices if not args.socket else None
+        if args.fleet is not None:
+            metric = "service_fleet_jobs_per_sec"
+            backend = f"verifyd-fleet[{args.fleet}]"
+        elif mesh is not None:
+            metric = "service_mesh_jobs_per_sec"
+            backend = f"verifyd-mesh[{mesh}]"
+        else:
+            metric = "service_jobs_per_sec"
+            backend = "verifyd"
         line = {
-            # the mesh row keeps its own metric name so the published
-            # single-path baseline is never overwritten by a mesh run
-            "metric": "service_jobs_per_sec"
-            if mesh is None
-            else "service_mesh_jobs_per_sec",
+            # the mesh/fleet rows keep their own metric names so the
+            # published single-path baseline is never overwritten
+            "metric": metric,
             "value": value,
             "unit": "jobs/s",
             # speedup vs BASELINE.json published service_jobs_per_sec
-            # (also for the mesh row — that's the comparison the row
-            # exists for); 0.0 only until a baseline is recorded there
+            # (also for the mesh/fleet rows — that's the comparison those
+            # rows exist for); 0.0 only until a baseline is recorded there
             "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
-            "backend": "verifyd" if mesh is None else f"verifyd-mesh[{mesh}]",
+            "backend": backend,
             "host_cpus": _host_cpus(),
             "cache_hits": cached_n[0],
             "rejects": rejects[0],
@@ -302,6 +379,12 @@ def main() -> int:
         }
         if mesh is not None:
             line["mesh_devices"] = mesh
+        if args.fleet is not None:
+            line["fleet"] = args.fleet
+            snap = router_ctx.snapshot()
+            line["routed"] = snap["routed"]
+            line["stolen"] = snap["stolen"]
+            line["failovers"] = snap["failovers"]
         print(json.dumps(line), flush=True)
         if daemon_ctx is not None:
             if daemon_ctx.metrics_port is not None:
@@ -329,6 +412,16 @@ def main() -> int:
     finally:
         if daemon_ctx is not None:
             daemon_ctx.__exit__(None, None, None)
+        if router_ctx is not None:
+            router_ctx.__exit__(None, None, None)
+        for proc in fleet_procs:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+                    proc.wait()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,385 @@
+"""Router tier: hash ring, breaker, prober, routing, drain, deadline.
+
+Unit layers run clockless (the breaker takes an injected clock, the
+ring is pure); the end-to-end layer boots two in-process ``Verifyd``
+backends (device off, one worker) behind an in-process
+``VerifydRouter`` on unix sockets — affinity, the edge cache, failover
+off a dead home node, the drain/undrain protocol, NoBackend when the
+fleet is gone, and the client's ``--deadline`` budget are all pinned
+here so ``make fleet`` (scripts/fleet_check.py) only has to prove the
+multi-process/SIGKILL story.
+"""
+
+import io
+
+import pytest
+
+from s2_verification_tpu.obs.probe import CircuitBreaker, HealthProber
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.service.client import (
+    VerifydClient,
+    VerifydDeadlineExceeded,
+    VerifydError,
+)
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.router import (
+    BackendSpec,
+    HashRing,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history(base: int = 100) -> str:
+    h = H()
+    h.append_ok(1, [base + 1], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+    return _text(h)
+
+
+def bad_history(base: int = 100) -> str:
+    h = H()
+    h.append_ok(1, [base + 1], tail=1)
+    h.read_ok(2, tail=1, stream_hash=base)  # impossible stream hash
+    return _text(h)
+
+
+# -- hash ring ----------------------------------------------------------------
+
+
+def test_ring_deterministic_and_complete():
+    ring = HashRing(["a", "b", "c"], replicas=64)
+    keys = [f"v1:{i:016x}:4" for i in range(200)]
+    owners = {k: ring.lookup(k) for k in keys}
+    assert set(owners.values()) == {"a", "b", "c"}  # all nodes own keys
+    again = HashRing(["c", "a", "b"], replicas=64)  # order-independent
+    assert {k: again.lookup(k) for k in keys} == owners
+
+
+def test_ring_remove_remaps_only_the_lost_nodes_keys():
+    ring = HashRing(["a", "b", "c"], replicas=64)
+    keys = [f"v1:{i:016x}:4" for i in range(300)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("b")
+    after = {k: ring.lookup(k) for k in keys}
+    for k in keys:
+        if before[k] != "b":
+            # Stability: a surviving node's keys never move.
+            assert after[k] == before[k]
+        else:
+            assert after[k] in ("a", "c")
+
+
+def test_ring_add_restores_ownership():
+    ring = HashRing(["a", "b", "c"], replicas=64)
+    keys = [f"v1:{i:016x}:4" for i in range(300)]
+    before = {k: ring.lookup(k) for k in keys}
+    ring.remove("b")
+    ring.add("b")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_ring_preference_is_home_first_all_distinct():
+    ring = HashRing(["a", "b", "c"], replicas=64)
+    pref = ring.preference("some-fingerprint")
+    assert sorted(pref) == ["a", "b", "c"]
+    assert pref[0] == ring.lookup("some-fingerprint")
+
+
+def test_ring_empty_and_bad_replicas():
+    assert HashRing().lookup("x") is None
+    assert HashRing().preference("x") == []
+    with pytest.raises(ValueError):
+        HashRing(replicas=0)
+
+
+# -- circuit breaker (injected clock — no sleeping) ---------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_consecutive_failures():
+    clk = _Clock()
+    br = CircuitBreaker(failures=3, reset_s=5.0, time_fn=clk)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # under threshold
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # success reset the streak
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()
+
+
+def test_breaker_half_open_probe_single_slot():
+    clk = _Clock()
+    br = CircuitBreaker(failures=1, reset_s=5.0, time_fn=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.t = 4.9
+    assert not br.allow()
+    clk.t = 5.1
+    assert br.allow()  # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()  # concurrent caller refused
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens_with_fresh_window():
+    clk = _Clock()
+    br = CircuitBreaker(failures=1, reset_s=5.0, time_fn=clk)
+    br.record_failure()
+    clk.t = 6.0
+    assert br.allow()
+    br.record_failure()  # probe failed
+    assert br.state == "open"
+    clk.t = 10.0  # 4s into the NEW window
+    assert not br.allow()
+    clk.t = 11.1
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_reset_forces_closed():
+    br = CircuitBreaker(failures=1, reset_s=1000.0, time_fn=lambda: 0.0)
+    br.record_failure()
+    assert br.state == "open"
+    br.reset()
+    assert br.state == "closed" and br.allow()
+    with pytest.raises(ValueError):
+        CircuitBreaker(failures=0)
+
+
+# -- health prober (synchronous ticks, fake probes) ---------------------------
+
+
+def test_prober_reports_first_observation_and_transitions():
+    up = {"a": True, "b": False}
+    changes = []
+    prober = HealthProber(
+        {n: (lambda n=n: up[n]) for n in up},
+        on_change=lambda name, ok: changes.append((name, ok)),
+    )
+    assert prober.probe_once() == {"a": True, "b": False}
+    assert sorted(changes) == [("a", True), ("b", False)]  # first obs fires
+    changes.clear()
+    prober.probe_once()
+    assert changes == []  # steady state is silent
+    up["b"] = True
+    prober.probe_once()
+    assert changes == [("b", True)]
+    assert prober.status == {"a": True, "b": True}
+
+
+def test_prober_raising_probe_reads_down():
+    def boom():
+        raise OSError("probe exploded")
+
+    prober = HealthProber({"x": boom})
+    assert prober.probe_once() == {"x": False}
+    assert prober.status["x"] is False
+
+
+# -- backend spec -------------------------------------------------------------
+
+
+def test_backend_spec_parse():
+    s = BackendSpec.parse("a=/tmp/a.sock")
+    assert (s.name, s.address, s.healthz_url) == ("a", "/tmp/a.sock", None)
+    s = BackendSpec.parse("b=127.0.0.1:7000@http://127.0.0.1:9000/healthz")
+    assert s.address == "127.0.0.1:7000"
+    assert s.healthz_url == "http://127.0.0.1:9000/healthz"
+    for bad in ("no-equals", "=addr", "name="):
+        with pytest.raises(ValueError):
+            BackendSpec.parse(bad)
+
+
+# -- end-to-end topology helpers ----------------------------------------------
+
+
+def _backend_cfg(tmp_path, name: str) -> VerifydConfig:
+    return VerifydConfig(
+        socket_path=str(tmp_path / f"{name}.sock"),
+        workers=1,
+        device="off",
+        no_viz=True,
+        stats_log=None,
+        out_dir=str(tmp_path / f"viz-{name}"),
+    )
+
+
+def _router_cfg(tmp_path, names, **overrides) -> RouterConfig:
+    kw = dict(
+        listen=str(tmp_path / "router.sock"),
+        backends=tuple(
+            BackendSpec(n, str(tmp_path / f"{n}.sock")) for n in names
+        ),
+        probe_interval_s=30.0,  # tests drive probe_once() themselves
+        breaker_failures=2,
+        breaker_reset_s=0.2,
+        max_failovers=2,
+    )
+    kw.update(overrides)
+    return RouterConfig(**kw)
+
+
+def _fingerprint(text: str) -> str:
+    return history_fingerprint(
+        prepare(list(ev.iter_history(text)), elide_trivial=True)
+    )
+
+
+def _homed_at(router: VerifydRouter, node: str, base: int = 10_000) -> str:
+    """A fresh linearizable history whose ring home is ``node``."""
+    while True:
+        base += 1000
+        text = good_history(base)
+        if router.ring.preference(_fingerprint(text))[0] == node:
+            return text
+
+
+def test_router_affinity_cache_and_fleet_view(tmp_path):
+    with Verifyd(_backend_cfg(tmp_path, "a")), Verifyd(
+        _backend_cfg(tmp_path, "b")
+    ), VerifydRouter(_router_cfg(tmp_path, ("a", "b"))) as router:
+        client = VerifydClient(router.cfg.listen)
+        assert client.ping()["server"] == "verifyd-router"
+
+        texts = {0: good_history(100), 1: bad_history(200)}
+        first = {v: client.submit(t, no_viz=True) for v, t in texts.items()}
+        for verdict, reply in first.items():
+            assert reply["verdict"] == verdict
+            assert reply["node"] == router.ring.lookup(
+                _fingerprint(texts[verdict])
+            )
+            assert not reply.get("cached")
+        # Duplicate: answered from the router's edge cache, provenance
+        # (the home node) preserved.
+        for verdict, text in texts.items():
+            again = client.submit(text, no_viz=True)
+            assert again["verdict"] == verdict
+            assert again["cached"] and again["router_cached"]
+            assert again["node"] == first[verdict]["node"]
+
+        fleet = client.fleet()
+        assert fleet["ring"]["nodes"] == ["a", "b"]
+        assert [b["name"] for b in fleet["backends"]] == ["a", "b"]
+        assert all(not b["draining"] for b in fleet["backends"])
+
+        snap = client.stats()
+        assert snap["routed"] == 2 and snap["cache_hits"] == 2
+        assert "slo" in snap and "metrics" in snap
+
+
+def test_router_failover_when_home_dies(tmp_path):
+    backend_a = Verifyd(_backend_cfg(tmp_path, "a")).__enter__()
+    try:
+        with Verifyd(_backend_cfg(tmp_path, "b")), VerifydRouter(
+            _router_cfg(tmp_path, ("a", "b"))
+        ) as router:
+            client = VerifydClient(router.cfg.listen)
+            text = _homed_at(router, "a")
+            backend_a.__exit__(None, None, None)  # the home node dies
+            reply = client.submit(text, no_viz=True)
+            assert reply["verdict"] == 0
+            assert reply["node"] == "b"  # failed over, job not lost
+            assert client.stats()["failovers"] >= 1
+    finally:
+        # Idempotent: already exited inside the happy path.
+        backend_a.request_stop()
+
+
+def test_router_drain_undrain_protocol(tmp_path):
+    with Verifyd(_backend_cfg(tmp_path, "a")), Verifyd(
+        _backend_cfg(tmp_path, "b")
+    ), VerifydRouter(_router_cfg(tmp_path, ("a", "b"))) as router:
+        client = VerifydClient(router.cfg.listen)
+        text = _homed_at(router, "a")
+        drain = client.drain("a", drain_timeout_s=5.0, timeout=None)
+        assert drain["node"] == "a" and drain["drained"]
+        fleet = {b["name"]: b for b in client.fleet()["backends"]}
+        assert fleet["a"]["draining"]
+        # A fresh history homed at the drained node routes around it.
+        reply = client.submit(text, no_viz=True)
+        assert reply["verdict"] == 0 and reply["node"] == "b"
+        # Unknown node: a semantic error, not a crash.
+        with pytest.raises(VerifydError):
+            client.drain("nope")
+        client.undrain("a")
+        fleet = {b["name"]: b for b in client.fleet()["backends"]}
+        assert not fleet["a"]["draining"]
+
+
+def test_router_no_backend_when_fleet_is_gone(tmp_path):
+    cfg = _backend_cfg(tmp_path, "a")
+    with Verifyd(cfg):
+        pass  # boots and exits: the socket path is gone
+    with VerifydRouter(_router_cfg(tmp_path, ("a",))) as router:
+        router.prober.probe_once()
+        client = VerifydClient(router.cfg.listen)
+        with pytest.raises(VerifydError) as ei:
+            client.submit(good_history(300), no_viz=True)
+        assert ei.value.cls == "NoBackend"
+        assert client.stats()["no_backend"] == 1
+
+
+def test_router_decode_error_answered_at_the_edge(tmp_path):
+    with Verifyd(_backend_cfg(tmp_path, "a")), VerifydRouter(
+        _router_cfg(tmp_path, ("a",))
+    ) as router:
+        client = VerifydClient(router.cfg.listen)
+        with pytest.raises(VerifydError) as ei:
+            client.submit("not json at all\n", no_viz=True)
+        assert ei.value.cls == "DecodeError"
+        assert client.stats()["decode_errors"] == 1
+        assert client.stats()["routed"] == 0  # no backend burned a slot
+
+
+# -- submit --deadline --------------------------------------------------------
+
+
+def test_deadline_exceeded_raises_with_budget_and_attempts(tmp_path):
+    client = VerifydClient(str(tmp_path / "nothing-listens-here.sock"))
+    with pytest.raises(VerifydDeadlineExceeded) as ei:
+        client.submit_with_retry(
+            good_history(), retries=50, backoff_s=0.05, deadline_s=0.4
+        )
+    e = ei.value
+    assert e.deadline_s == 0.4
+    assert e.attempts >= 1
+    assert f"deadline exceeded after {e.attempts} attempts" in str(e)
+    # The budget is honored as a VerifydUnavailable subtype: exit 69.
+    from s2_verification_tpu.service.client import VerifydUnavailable
+
+    assert isinstance(e, VerifydUnavailable)
+
+
+def test_deadline_none_keeps_plain_unavailable(tmp_path):
+    from s2_verification_tpu.service.client import VerifydUnavailable
+
+    client = VerifydClient(str(tmp_path / "nothing-listens-here.sock"))
+    with pytest.raises(VerifydUnavailable) as ei:
+        client.submit_with_retry(good_history(), retries=1, backoff_s=0.01)
+    assert not isinstance(ei.value, VerifydDeadlineExceeded)
