@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet perfgate clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload perfgate clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: obs mesh fleet
+chaos-full: obs mesh fleet overload
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -60,6 +60,15 @@ perfgate:
 # per-shard metric families must populate.
 mesh:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/mesh_check.py
+
+# Overload-protection gate (scripts/overload_check.py): poison-job
+# quarantine within 3 SIGKILL boots with zero impact on an innocent
+# journal-mate, a 2s deadline freeing worker+child+lease within
+# deadline+grace, injected ENOSPC degrading to explicit non-durable
+# mode without dropping in-flight jobs, and the armed
+# AdmissionController within 3% of a disarmed service_bench run.
+overload:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/overload_check.py
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

@@ -84,6 +84,8 @@ def drive(
     log: Callable[[str], None] | None = None,
     tracer: Tracer = NULL_TRACER,
     trace_tid: int = 0,
+    cancel: Callable[[], str | None] | None = None,
+    grace_s: float = 5.0,
 ) -> DriveOutcome:
     """Run ``cmd`` in a bounded child until ``done()`` reports a conclusive
     result, restarting through crashes and hangs.
@@ -100,6 +102,11 @@ def drive(
     backend-probe wait) on the caller's trace track — verifyd passes its
     job track here so supervised device escalations show their restart
     structure in the trace export.
+
+    ``cancel`` (cooperative cancellation): polled every quarter second
+    while the child runs; a non-None reason SIGTERMs the child's process
+    group, waits ``grace_s`` for a clean exit, SIGKILLs it otherwise,
+    and returns a failed outcome noting the reason — no relaunch.
     """
     say = log or (lambda s: print(f"# resilient: {s}", file=sys.stderr, flush=True))
     attempts = 0
@@ -122,16 +129,55 @@ def drive(
     try:
         while attempts <= max_restarts:
             attempts += 1
+            if cancel is not None:
+                reason = cancel()
+                if reason:
+                    return DriveOutcome(
+                        False, attempts - 1, last_rc, f"cancelled ({reason})"
+                    )
             say(f"attempt {attempts}: {' '.join(cmd)}")
             t_att = tracer.now()
             child = subprocess.Popen(list(cmd), start_new_session=True)
             current[0] = child
+            cancelled_reason: str | None = None
+            deadline = time.monotonic() + attempt_timeout_s
             try:
-                last_rc = child.wait(timeout=attempt_timeout_s)
-            except subprocess.TimeoutExpired:
-                _kill_tree(child)
-                last_rc = None
-                say(f"attempt {attempts} hung >{attempt_timeout_s:.0f}s; killed")
+                # Chunked wait so the cancel flag is polled while the
+                # child runs; the plain timeout path is the chunk sum.
+                while True:
+                    try:
+                        last_rc = child.wait(
+                            timeout=min(
+                                0.25, max(0.0, deadline - time.monotonic())
+                            )
+                        )
+                        break
+                    except subprocess.TimeoutExpired:
+                        if cancel is not None:
+                            cancelled_reason = cancel()
+                            if cancelled_reason:
+                                # SIGTERM → grace → SIGKILL: give the
+                                # child a chance to flush its checkpoint.
+                                with contextlib.suppress(ProcessLookupError):
+                                    os.killpg(child.pid, signal.SIGTERM)
+                                try:
+                                    last_rc = child.wait(timeout=grace_s)
+                                except subprocess.TimeoutExpired:
+                                    _kill_tree(child)
+                                    last_rc = None
+                                say(
+                                    f"attempt {attempts} cancelled "
+                                    f"({cancelled_reason}); child stopped"
+                                )
+                                break
+                        if time.monotonic() >= deadline:
+                            _kill_tree(child)
+                            last_rc = None
+                            say(
+                                f"attempt {attempts} hung "
+                                f">{attempt_timeout_s:.0f}s; killed"
+                            )
+                            break
             finally:
                 current[0] = None
             finished = done()
@@ -145,6 +191,10 @@ def drive(
             )
             if finished:
                 return DriveOutcome(True, attempts, last_rc, "conclusive")
+            if cancelled_reason:
+                return DriveOutcome(
+                    False, attempts, last_rc, f"cancelled ({cancelled_reason})"
+                )
             if last_rc is not None:
                 say(f"attempt {attempts} exited rc={last_rc} without a result")
             if attempts > max_restarts:

@@ -572,6 +572,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         resource_sample_s=args.resource_sample,
         retrace_storm_threshold=args.retrace_storm,
         dashboard_sample_s=args.dashboard_sample,
+        max_rss_frac=args.max_rss_frac,
+        deadline_grace_s=args.deadline_grace,
+        quarantine_threshold=args.quarantine_threshold,
     )
     daemon = Verifyd(cfg)
 
@@ -785,6 +788,97 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     # Exit codes mirror the verdict: 0 clean shutdown, 1 unclean death —
     # scriptable ("did the last run die?") without parsing the report.
     return 0 if pm["clean_shutdown"] else 1
+
+
+def _print_quarantine_entries(entries: list, threshold) -> None:
+    if not entries:
+        print("quarantine empty", flush=True)
+        return
+    print(f"{'FINGERPRINT':36.36s} {'CRASHES':>7s} {'SINCE':20s} KINDS")
+    import time as _time
+
+    for ent in entries:
+        since = ent.get("since")
+        when = (
+            _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime(float(since)))
+            if since
+            else "?"
+        )
+        kinds = ",".join(
+            f"{k}={v}" for k, v in sorted((ent.get("kinds") or {}).items())
+        )
+        print(
+            f"{str(ent.get('fingerprint', '?')):36.36s} "
+            f"{ent.get('crashes', '?'):>7} {when:20s} {kinds}"
+        )
+    print(
+        f"-- {len(entries)} quarantined (threshold {threshold}); "
+        "release with: quarantine release FINGERPRINT",
+        flush=True,
+    )
+
+
+def _cmd_quarantine(args: argparse.Namespace) -> int:
+    """Poison-job quarantine: list / inspect / release, against a live
+    daemon (socket) or a dead one's --state-dir (cold file read; release
+    cold requires the daemon to be stopped)."""
+    import json as _json
+
+    action = args.quarantine_cmd
+    fp = getattr(args, "fingerprint", None)
+    if not args.state_dir and not args.socket:
+        log.error("quarantine %s needs --socket or --state-dir", action)
+        return USAGE_EXIT
+    if args.state_dir:
+        from .service.overload import QuarantineStore
+
+        store = QuarantineStore(os.path.join(args.state_dir, "quarantine"))
+        if action == "list":
+            _print_quarantine_entries(store.list(), store.threshold)
+            return 0
+        if action == "inspect":
+            info = store.get(fp)
+            if info is None:
+                log.error("%s is not quarantined", fp)
+                return 1
+            print(_json.dumps(info, sort_keys=True), flush=True)
+            return 0
+        released = store.release(fp)
+        print(_json.dumps({"released": released, "fingerprint": fp}), flush=True)
+        return 0 if released else 1
+
+    from .service.client import (
+        VerifydClient,
+        VerifydError,
+        VerifydUnavailable,
+    )
+    from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    try:
+        reply = client.quarantine(action, fp)
+    except VerifydUnavailable as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+        return EXIT_UNAVAILABLE
+    except VerifydError as e:
+        log.error("quarantine %s refused: %s", action, e)
+        return EXIT_PROTOCOL
+    except (OSError, TimeoutError) as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e)
+        return EXIT_UNAVAILABLE
+    if action == "list":
+        _print_quarantine_entries(
+            reply.get("entries", []), reply.get("threshold", "?")
+        )
+        return 0
+    print(_json.dumps(reply, sort_keys=True), flush=True)
+    if action == "release" and not reply.get("released"):
+        return 1
+    return 0
 
 
 #: export column order — stable so downstream scripts can rely on it.
@@ -1531,6 +1625,35 @@ def build_parser() -> argparse.ArgumentParser:
         "then exit.  0 (default) keeps the immediate-stop behavior; "
         "the router's rolling restart needs this > 0",
     )
+    s.add_argument(
+        "--max-rss-frac",
+        type=float,
+        default=0.0,
+        metavar="FRACTION",
+        help="pressure-aware admission: shed new submits (honest "
+        "retry_after, QueueFull) while daemon RSS exceeds this fraction "
+        "of MemTotal, and while open fds near RLIMIT_NOFILE "
+        "(default 0 = shedding off)",
+    )
+    s.add_argument(
+        "--deadline-grace",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="SIGTERM-to-SIGKILL grace for supervised children of "
+        "cancelled jobs (deadline expiry, client gone, shutdown) "
+        "(default 2.0)",
+    )
+    s.add_argument(
+        "--quarantine-threshold",
+        type=int,
+        default=3,
+        metavar="N",
+        help="poison-job quarantine: a fingerprint observed in-flight "
+        "across this many process deaths or supervised-child kills is "
+        "quarantined (definite Quarantined error) instead of replayed; "
+        "needs --state-dir (default 3)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
 
     r = sub.add_parser(
@@ -1743,6 +1866,50 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit the full post-mortem as JSON instead of the report",
     )
     d.set_defaults(fn=_cmd_doctor)
+
+    qp = sub.add_parser(
+        "quarantine",
+        help="poison-job quarantine: list / inspect / release fingerprints "
+        "a live daemon (--socket) or a dead one's --state-dir holds",
+    )
+    qsub = qp.add_subparsers(dest="quarantine_cmd", required=True)
+
+    def _quarantine_common(p: argparse.ArgumentParser) -> None:
+        p.add_argument(
+            "-socket",
+            "--socket",
+            default=None,
+            help="a running daemon: unix-socket path, or HOST:PORT for "
+            "the authenticated TCP transport (needs --secret-file or "
+            "VERIFYD_SECRET)",
+        )
+        p.add_argument(
+            "--state-dir",
+            default=None,
+            help="cold path: read the quarantine ledger straight from a "
+            "state dir (release this way only with the daemon stopped)",
+        )
+        p.add_argument(
+            "--secret-file",
+            default=None,
+            help="shared-secret file for the TCP transport",
+        )
+        p.set_defaults(fn=_cmd_quarantine)
+
+    ql = qsub.add_parser("list", help="show quarantined fingerprints")
+    _quarantine_common(ql)
+    qi = qsub.add_parser(
+        "inspect", help="full crash ledger for one fingerprint"
+    )
+    qi.add_argument("fingerprint", help="fingerprint to inspect")
+    _quarantine_common(qi)
+    qr = qsub.add_parser(
+        "release",
+        help="operator override: un-quarantine a fingerprint and reset "
+        "its crash count (the next submit runs it again)",
+    )
+    qr.add_argument("fingerprint", help="fingerprint to release")
+    _quarantine_common(qr)
 
     pr = sub.add_parser(
         "profiles",

@@ -142,11 +142,15 @@ def parse_rule(spec: str) -> AlertRule:
 
 
 def builtin_rules() -> Tuple[AlertRule, ...]:
-    """The three signals every deployment should page on."""
+    """The signals every deployment should page on: SLO burn, perf
+    regressions, retrace storms, a poison job entering quarantine, and a
+    durable writer degrading (journal on a full disk)."""
     return (
         AlertRule(name="slo_breach", kind="event", event="slo_breach"),
         AlertRule(name="perf_regression", kind="event", event="perf_regression"),
         AlertRule(name="retrace_storm", kind="event", event="retrace_storm"),
+        AlertRule(name="job_quarantined", kind="event", event="job_quarantined"),
+        AlertRule(name="writer_degraded", kind="event", event="writer_degraded"),
     )
 
 

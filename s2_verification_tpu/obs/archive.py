@@ -115,6 +115,20 @@ class ProfileArchive:
         #: job id → lease wait, correlated from lease_grant to done
         self._pending_lease: Dict[Any, float] = {}
         self._closed = False
+        #: optional service.overload.DegradedWriter: ENOSPC drops records
+        #: cheaply (counted, evented) and re-arms when the disk recovers
+        self.writer = None
+
+    def _append(self, seg_log: SegmentLog, payload: bytes) -> bool:
+        """One append, through the degradation policy when armed."""
+        if self.writer is not None:
+            _, landed = self.writer.run(lambda: seg_log.append(payload))
+            return landed
+        try:
+            seg_log.append(payload)
+            return True
+        except (OSError, ValueError, TypeError):
+            return False  # archival must never take a job down
 
     # -- write side ---------------------------------------------------------
 
@@ -146,13 +160,13 @@ class ProfileArchive:
             if self._closed:
                 return
             try:
-                self._records_log.append(
-                    json.dumps(rec, separators=(",", ":"), default=str).encode(
-                        "utf-8"
-                    )
-                )
-            except (OSError, ValueError, TypeError):
+                payload = json.dumps(
+                    rec, separators=(",", ":"), default=str
+                ).encode("utf-8")
+            except (ValueError, TypeError):
                 return  # archival must never take a job down
+            if not self._append(self._records_log, payload):
+                return
             self._records.append(rec)
 
     def add_history(self, fp: str, text: str) -> bool:
@@ -160,13 +174,10 @@ class ProfileArchive:
         with self._lock:
             if self._closed or fp in self._histories:
                 return False
-            try:
-                self._corpus_log.append(
-                    json.dumps(
-                        {"fp": fp, "history": text}, separators=(",", ":")
-                    ).encode("utf-8")
-                )
-            except (OSError, ValueError, TypeError):
+            payload = json.dumps(
+                {"fp": fp, "history": text}, separators=(",", ":")
+            ).encode("utf-8")
+            if not self._append(self._corpus_log, payload):
                 return False
             self._histories[fp] = text
             return True

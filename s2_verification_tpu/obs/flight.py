@@ -65,14 +65,22 @@ class FlightRecorder:
             fsync=fsync,
         )
         self._closed = False
+        #: optional service.overload.DegradedWriter: on ENOSPC the ring
+        #: drops records cheaply (counted, evented) and re-arms when the
+        #: disk recovers, instead of paying a failing syscall per record
+        self.writer = None
 
     def _append(self, rec: Dict[str, Any]) -> None:
         if self._closed:
             return
         try:
-            self._log.append(
-                json.dumps(rec, separators=(",", ":"), default=str).encode("utf-8")
+            payload = json.dumps(rec, separators=(",", ":"), default=str).encode(
+                "utf-8"
             )
+            if self.writer is not None:
+                self.writer.run(lambda: self._log.append(payload))
+                return
+            self._log.append(payload)
         except (OSError, ValueError, TypeError):
             pass  # the black box must never take the plane down
 
@@ -168,12 +176,24 @@ def postmortem(
 
     # Open leases: grants never matched by a release/timeout of the same job.
     open_leases: Dict[Any, Dict[str, Any]] = {}
+    # Degraded writers: writer_degraded events not healed by a later
+    # writer_recovered for the same writer.  Cancellations: counts by
+    # reason, so "deadline ×12" reads at a glance.
+    degraded_writers: Dict[str, Dict[str, Any]] = {}
+    cancellations: Dict[str, int] = {}
     for ev in events:
         name = ev.get("ev") or ev.get("event")
         if name == "lease_grant":
             open_leases[ev.get("job")] = ev
         elif name in ("lease_release", "lease_timeout"):
             open_leases.pop(ev.get("job"), None)
+        elif name == "writer_degraded":
+            degraded_writers[str(ev.get("writer", "?"))] = ev
+        elif name == "writer_recovered":
+            degraded_writers.pop(str(ev.get("writer", "?")), None)
+        elif name == "job_cancelled":
+            reason = str(ev.get("reason", "other"))
+            cancellations[reason] = cancellations.get(reason, 0) + 1
 
     # SLO at death: replay recorded request-outcome events (each carries
     # its own wall ``t``) into a fresh engine, evaluated at the last
@@ -206,6 +226,22 @@ def postmortem(
         and last.get("reason") in ("shutdown", "sigterm", "sigint")
     )
 
+    # Quarantine ledger: cold read of the store file — the dead daemon's
+    # poison history is part of the story (a crash loop often IS a poison
+    # job the threshold never caught).
+    quarantine: Dict[str, Any] = {}
+    qpath = os.path.join(state_dir, "quarantine", "quarantine.json")
+    try:
+        with open(qpath, encoding="utf-8") as f:
+            qdata = json.load(f)
+        if isinstance(qdata, dict):
+            quarantine = {
+                "quarantined": qdata.get("quarantined", {}) or {},
+                "crashes": qdata.get("crashes", {}) or {},
+            }
+    except (OSError, ValueError):
+        pass
+
     return {
         "state_dir": state_dir,
         "records": len(records),
@@ -221,6 +257,9 @@ def postmortem(
         "tail": records[-tail:],
         "orphans": _journal_orphans(state_dir),
         "open_leases": list(open_leases.values()),
+        "quarantine": quarantine,
+        "degraded_writers": list(degraded_writers.values()),
+        "cancellations": cancellations,
         "slowest_spans": slowest,
         "slo_at_death": slo_at_death,
         # Resource timeline before death: keep the tail — the interesting
@@ -333,6 +372,48 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                 "  job=%s fp=%s client=%s"
                 % (rec.get("job"), str(rec.get("fp", ""))[:16], rec.get("client"))
             )
+
+    q = pm.get("quarantine") or {}
+    if q.get("quarantined") or q.get("crashes"):
+        add("")
+        add(
+            "-- quarantine: %d fingerprint(s) held, %d with crash history --"
+            % (len(q.get("quarantined", {})), len(q.get("crashes", {})))
+        )
+        for fp, ent in sorted(q.get("quarantined", {}).items())[:10]:
+            add(
+                "  HELD %s  crashes=%s kinds=%s since=%s"
+                % (
+                    fp[:16],
+                    ent.get("crashes"),
+                    json.dumps(ent.get("kinds", {}), sort_keys=True),
+                    _fmt_t(ent.get("since")),
+                )
+            )
+        for fp, ent in sorted(q.get("crashes", {}).items())[:10]:
+            if fp not in q.get("quarantined", {}):
+                add("  warm %s  crashes=%s" % (fp[:16], ent.get("count")))
+
+    if pm.get("degraded_writers"):
+        add("")
+        add(
+            "-- writers degraded at death: %d --" % len(pm["degraded_writers"])
+        )
+        for ev in pm["degraded_writers"]:
+            add(
+                "  %s  writer=%s error=%s"
+                % (_fmt_t(ev.get("t")), ev.get("writer"), ev.get("error"))
+            )
+
+    if pm.get("cancellations"):
+        add("")
+        add(
+            "-- cancellations --  "
+            + "  ".join(
+                "%s=%d" % (r, n)
+                for r, n in sorted(pm["cancellations"].items())
+            )
+        )
 
     if pm["open_leases"]:
         add("")

@@ -122,6 +122,10 @@ class SLOHealth:
         self._breached = False
         self._last_breach: Optional[Dict[str, Any]] = None
         self._breach_count = 0
+        #: operational degradations reported from outside the SLO math
+        #: (e.g. a journal writer on a full disk): key → detail dict.
+        #: Any entry forces /healthz to degraded with a "degraded" reason.
+        self._degraded_reasons: Dict[str, Dict[str, Any]] = {}
         self._m_avail = self._m_burn = self._m_lat = None
         self._m_healthy = self._m_breaches = None
         if registry is not None:
@@ -257,7 +261,11 @@ class SLOHealth:
             breached = self._breached
             last_breach = self._last_breach
             breach_count = self._breach_count
+            degraded = {k: dict(v) for k, v in self._degraded_reasons.items()}
         healthy, reasons = self._verdict(windows)
+        for key, detail in sorted(degraded.items()):
+            reasons.append({"kind": "degraded", "what": key, **detail})
+            healthy = False
         return {
             "healthy": healthy,
             "reasons": reasons,
@@ -310,6 +318,17 @@ class SLOHealth:
         return (not reasons), reasons
 
     # ------------------------------------------------------------ surface
+
+    def set_degraded(self, key: str, **detail: Any) -> None:
+        """Mark an operational degradation (journal on a full disk, …):
+        /healthz answers 503 with a ``degraded`` reason naming ``key``
+        until :meth:`clear_degraded` re-arms it."""
+        with self._lock:
+            self._degraded_reasons[key] = dict(detail)
+
+    def clear_degraded(self, key: str) -> None:
+        with self._lock:
+            self._degraded_reasons.pop(key, None)
 
     def healthz(self) -> Tuple[bool, Dict[str, Any]]:
         """The /healthz verdict: (healthy, body).  Body is small and

@@ -76,10 +76,15 @@ class VerdictCache:
         *,
         fsync: bool = False,
         max_segments: int = 8,
+        writer=None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"cache capacity must be >= 1, got {capacity}")
         self.capacity = capacity
+        #: optional overload.DegradedWriter: spill failures then degrade to
+        #: memory-only with counters and re-arm when the disk recovers,
+        #: instead of the legacy permanently-disable-on-first-error policy.
+        self.writer = writer
         self._lock = threading.Lock()
         self._entries: OrderedDict[str, dict] = OrderedDict()
         self._log: SegmentLog | None = None
@@ -122,13 +127,20 @@ class VerdictCache:
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
             if self._log is not None:
+                record = json.dumps(
+                    {"fp": fingerprint, "p": payload}, separators=(",", ":")
+                ).encode("utf-8")
+                if self.writer is not None:
+                    # Spill is best-effort: ENOSPC degrades to memory-only
+                    # (counted + evented) and recovery re-arms the log.
+                    try:
+                        self.writer.run(lambda: self._log.append(record))
+                    except ValueError:
+                        log.exception("verdict-cache spill failed; disabling")
+                        self._log = None
+                    return
                 try:
-                    self._log.append(
-                        json.dumps(
-                            {"fp": fingerprint, "p": payload},
-                            separators=(",", ":"),
-                        ).encode("utf-8")
-                    )
+                    self._log.append(record)
                 except (OSError, ValueError):
                     # Spill is best-effort: a full disk must not fail jobs.
                     log.exception("verdict-cache spill failed; disabling")

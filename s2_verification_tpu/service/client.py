@@ -290,6 +290,19 @@ class VerifydClient:
         """Router only: return a drained node to the routable set."""
         return self._call({"op": "undrain", "node": node}, timeout=timeout)
 
+    def quarantine(
+        self,
+        action: str = "list",
+        fingerprint: str | None = None,
+        timeout: float | None = 10.0,
+    ) -> dict:
+        """Poison-job quarantine ops: ``list`` / ``inspect`` / ``release``
+        (the latter two take a fingerprint)."""
+        req: dict = {"op": "quarantine", "action": action}
+        if fingerprint is not None:
+            req["fingerprint"] = fingerprint
+        return self._call(req, timeout=timeout)
+
     def submit(
         self,
         history_text: str,
@@ -299,13 +312,19 @@ class VerifydClient:
         no_viz: bool | None = None,
         timeout: float | None = None,
         trace_id: str | None = None,
+        deadline_s: float | None = None,
     ) -> dict:
         """Submit one history.  Mints a distributed ``trace_id`` (unless
         the caller supplies one, e.g. across a retry loop) and sends it in
         the optional ``trace`` frame field — old daemons ignore it; new
         daemons thread it through every span and echo it back.  The reply
         always carries ``trace_id`` (filled in client-side against an old
-        daemon), so callers can correlate unconditionally."""
+        daemon), so callers can correlate unconditionally.
+
+        ``deadline_s`` rides the frame as the end-to-end ``deadline``
+        field: the daemon refuses admissions it cannot meet and cancels
+        the search when the budget runs out mid-flight (definite
+        ``DeadlineExceeded``).  Old daemons ignore the field."""
         tid = trace_id or new_trace_id()
         req: dict = {
             "op": "submit",
@@ -316,6 +335,8 @@ class VerifydClient:
         }
         if no_viz is not None:
             req["no_viz"] = no_viz
+        if deadline_s is not None:
+            req["deadline"] = float(deadline_s)
         reply = self._call(req, timeout=timeout)
         if isinstance(reply, dict):
             reply.setdefault("trace_id", tid)
@@ -350,7 +371,9 @@ class VerifydClient:
         to the remaining budget, sleeps are truncated, and when the
         budget is spent :class:`VerifydDeadlineExceeded` raises — so a
         client cannot spin forever against a flapping node regardless of
-        the attempt count.
+        the attempt count.  The *remaining* budget also rides each
+        attempt's frame as the end-to-end ``deadline`` field, so the
+        daemon (or a router hop) enforces the same clock server-side.
         """
         rng = rng or random.Random()
         # One logical request = one trace id, however many wire attempts.
@@ -382,6 +405,9 @@ class VerifydClient:
             tmo = caller_timeout
             if rem is not None:
                 tmo = rem if tmo is None else min(tmo, rem)
+                # Each wire attempt carries what is LEFT of the budget,
+                # already net of sleeps and failed attempts.
+                kw["deadline_s"] = rem
             try:
                 return self.submit(history_text, timeout=tmo, **kw)
             except VerifydBusy as e:

@@ -52,11 +52,19 @@ from ..obs.trace import Tracer
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
 from .journal import JobJournal
+from .overload import (
+    AdmissionController,
+    CancelToken,
+    DegradedWriter,
+    QuarantineStore,
+)
 from .protocol import (
     ERR_AUTH,
+    ERR_DEADLINE,
     ERR_DECODE,
     ERR_FRAME,
     ERR_INTERNAL,
+    ERR_QUARANTINED,
     ERR_QUEUE_FULL,
     ERR_SHUTTING_DOWN,
     ERR_TOO_LARGE,
@@ -174,6 +182,15 @@ class VerifydConfig:
     dashboard_sample_s: float = 2.0
     #: retained dashboard ticks (sparkline history length)
     dashboard_capacity: int = 240
+    #: RSS watermark for the admission controller, as a fraction of
+    #: MemTotal: submits arriving past it are shed with an honest
+    #: retry_after instead of queued; <= 0 disables pressure shedding
+    max_rss_frac: float = 0.0
+    #: SIGTERM→SIGKILL grace for cancelled supervised children (also the
+    #: slack a 2 s-deadline job gets to actually free its worker)
+    deadline_grace_s: float = 2.0
+    #: process deaths / child kills per fingerprint before quarantine
+    quarantine_threshold: int = 3
     extra: dict = field(default_factory=dict)
 
 
@@ -273,6 +290,15 @@ class Verifyd:
             stats=self.stats,
             storm_threshold=config.retrace_storm_threshold,
         )
+        # Disk-full degradation: every persistent writer routes its appends
+        # through a DegradedWriter, so ENOSPC degrades the feature (dropped
+        # flight/archive records, memory-only cache, non-durable journal)
+        # instead of taking the daemon down.  flight/archive are built
+        # before stats exists, so their writers attach post-hoc.
+        if self.flight is not None:
+            self.flight.writer = DegradedWriter("flight", self.stats)
+        if self.archive is not None:
+            self.archive.writer = DegradedWriter("archive", self.stats)
         self.sampler = None
         if config.resource_sample_s > 0:
             self.sampler = ResourceSampler(
@@ -285,8 +311,14 @@ class Verifyd:
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
         )
+        self._cache_writer = (
+            DegradedWriter("cache", self.stats) if verdict_dir is not None else None
+        )
         self.cache = VerdictCache(
-            config.cache_capacity, verdict_dir, fsync=config.fsync
+            config.cache_capacity,
+            verdict_dir,
+            fsync=config.fsync,
+            writer=self._cache_writer,
         )
         if verdict_dir is not None:
             rec = self.cache.recovery
@@ -302,6 +334,27 @@ class Verifyd:
             if config.state_dir
             else None
         )
+        self._journal_writer = None
+        if self.journal is not None:
+            # Journal ENOSPC is the one degradation the client must *see*:
+            # replies carry durable=false, /healthz goes unhealthy with the
+            # reason, and the writer_degraded alert fires.  Recovery (disk
+            # freed, reprobe write succeeds) re-arms durability and clears
+            # the health reason.
+            self._journal_writer = DegradedWriter(
+                "journal",
+                self.stats,
+                on_degrade=lambda e: self.health.set_degraded("journal", error=e),
+                on_recover=lambda: self.health.clear_degraded("journal"),
+            )
+        self.quarantine = None
+        if config.state_dir:
+            self.quarantine = QuarantineStore(
+                os.path.join(config.state_dir, "quarantine"),
+                threshold=config.quarantine_threshold,
+                stats=self.stats,
+            )
+            self.stats.set_quarantine_size(len(self.quarantine))
         self.queue = AdmissionQueue(
             config.queue_depth, retry_hint=self.stats.retry_after_hint
         )
@@ -312,6 +365,14 @@ class Verifyd:
             self.device_pool = DevicePool(
                 config.mesh_devices, stats=self.stats
             )
+        # retry_after hints fold supervised lease-wait estimates in: the
+        # stats object reads pool waiters straight off this snapshot.
+        self.stats.device_pool = self.device_pool
+        self.admission = AdmissionController(
+            self.stats,
+            max_rss_frac=config.max_rss_frac,
+            sampler=self.sampler,
+        )
         self.scheduler = Scheduler(
             self.queue,
             self.cache,
@@ -330,6 +391,9 @@ class Verifyd:
             profile=config.profile,
             device_pool=self.device_pool,
             lease_timeout_s=config.lease_timeout_s,
+            journal_writer=self._journal_writer,
+            quarantine=self.quarantine,
+            cancel_grace_s=config.deadline_grace_s,
         )
         self._job_ids = itertools.count(1)
         #: submits between dispatch and reply-written (loop thread owns
@@ -440,6 +504,22 @@ class Verifyd:
             return
         for rec in self.journal.orphans():
             text = rec.get("history", "")
+            fp = str(rec.get("fp") or "")
+            if self.quarantine is not None and fp:
+                # Poison accounting BEFORE re-admission: an orphan a worker
+                # had *started* (journal "run" record) when the process
+                # died is one crash against its fingerprint.  Queued-only
+                # orphans are innocent bystanders — replayed for free.
+                if rec.get("started"):
+                    self.quarantine.note_crash(fp, kind="boot")
+                if self.quarantine.is_quarantined(fp):
+                    self.stats.emit(
+                        "orphan_quarantined",
+                        fingerprint=fp,
+                        client=rec.get("client"),
+                        crashes=self.quarantine.crash_count(fp),
+                    )
+                    continue  # compact() below drops the accept for good
             try:
                 events = list(ev.iter_history(text))
                 hist = prepare(events, elide_trivial=True)
@@ -701,7 +781,7 @@ class Verifyd:
                                 # never reached the client is a lost job.
                                 inflight = True
                                 self._inflight += 1
-                            resp = await self._dispatch(req)
+                            resp = await self._dispatch(req, reader)
                     await self._reply(writer, resp, secret)
                 finally:
                     if inflight:
@@ -723,7 +803,9 @@ class Verifyd:
         writer.write(encode_frame(resp))
         await writer.drain()
 
-    async def _dispatch(self, req: dict) -> dict:
+    async def _dispatch(
+        self, req: dict, reader: asyncio.StreamReader | None = None
+    ) -> dict:
         op = req.get("op")
         try:
             if op == "ping":
@@ -799,14 +881,48 @@ class Verifyd:
                     )
                 self.request_stop()
                 return ok({"stopping": True})
+            if op == "quarantine":
+                if self.quarantine is None:
+                    return err(
+                        ERR_DECODE,
+                        "no quarantine store (daemon runs without --state-dir)",
+                    )
+                action = str(req.get("action") or "list")
+                if action == "list":
+                    return ok(
+                        {
+                            "entries": self.quarantine.list(),
+                            "threshold": self.quarantine.threshold,
+                        }
+                    )
+                fp = str(req.get("fingerprint") or "")
+                if not fp:
+                    return err(
+                        ERR_DECODE, f"quarantine {action!r} needs a fingerprint"
+                    )
+                if action == "inspect":
+                    info = self.quarantine.get(fp)
+                    if info is None:
+                        return err(ERR_DECODE, f"{fp!r} is not quarantined")
+                    return ok(info)
+                if action == "release":
+                    return ok(
+                        {
+                            "released": self.quarantine.release(fp),
+                            "fingerprint": fp,
+                        }
+                    )
+                return err(ERR_DECODE, f"unknown quarantine action {action!r}")
             if op == "submit":
-                return await self._submit(req)
+                return await self._submit(req, reader)
             return err(ERR_DECODE, f"unknown op {op!r}")
         except Exception as e:  # protocol handler must never kill the loop
             log.exception("dispatch failed for op %r", op)
             return err(ERR_INTERNAL, repr(e))
 
-    async def _submit(self, req: dict) -> dict:
+    async def _submit(
+        self, req: dict, reader: asyncio.StreamReader | None = None
+    ) -> dict:
         t_recv = self.tracer.now()
         # Distributed trace context: honor a client-minted id (new
         # clients), mint one otherwise (old clients) — every job traces.
@@ -823,6 +939,17 @@ class Verifyd:
         except (TypeError, ValueError):
             return err(ERR_DECODE, f"priority must be an int, got {req.get('priority')!r}")
         no_viz = bool(req.get("no_viz", self.cfg.no_viz))
+        # Remaining end-to-end budget in seconds.  Optional (old clients
+        # never send it), HMAC-covered like every frame field, and already
+        # decremented by any router hop the frame crossed.
+        deadline = req.get("deadline")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                return err(
+                    ERR_DECODE, f"deadline must be a number, got {deadline!r}"
+                )
 
         t_prep0 = self.tracer.now()
         try:
@@ -853,16 +980,82 @@ class Verifyd:
             cached.update(cached=True, queue_wait_s=0.0, trace_id=trace_id)
             return ok(cached)
 
+        # Admission gates, in cost order, all BEFORE the journal sees the
+        # job (a shed admission owes the client nothing on replay):
+        # quarantine (definite — the router must not fail it over), dead
+        # deadline, then pressure shedding with an honest retry_after.
+        if self.quarantine is not None and self.quarantine.is_quarantined(
+            fingerprint
+        ):
+            info = self.quarantine.get(fingerprint) or {}
+            self.stats.emit(
+                "quarantine_reject",
+                client=client,
+                fingerprint=fingerprint,
+                crashes=info.get("crashes", 0),
+                trace_id=trace_id,
+            )
+            return err(
+                ERR_QUARANTINED,
+                f"fingerprint {fingerprint[:12]} is quarantined after "
+                f"{info.get('crashes', 0)} crash(es); "
+                "`s2-verification-tpu quarantine release` re-admits it",
+                fingerprint=fingerprint,
+                crashes=info.get("crashes", 0),
+            )
+        shape = shape_key(hist)
+        if deadline is not None and deadline <= 0:
+            self.stats.emit(
+                "admission_shed",
+                reason="deadline",
+                client=client,
+                trace_id=trace_id,
+            )
+            return err(
+                ERR_DEADLINE,
+                "deadline already expired at admission",
+                reason="deadline",
+            )
+        shed = self.admission.decide(
+            queue_depth=len(self.queue), deadline_s=deadline, shape=shape
+        )
+        if shed is not None:
+            self.stats.emit(
+                "admission_shed",
+                reason=shed,
+                client=client,
+                depth=len(self.queue),
+                trace_id=trace_id,
+            )
+            if shed == "deadline":
+                return err(
+                    ERR_DEADLINE,
+                    "cannot finish inside the deadline at the current "
+                    "queue depth (observed per-shape wall time)",
+                    reason=shed,
+                )
+            return err(
+                ERR_QUEUE_FULL,
+                f"admission shed under {shed} pressure",
+                retry_after_s=self.stats.retry_after_hint(len(self.queue)),
+                reason=shed,
+                depth=len(self.queue),
+            )
+
+        cancel = CancelToken(
+            time.monotonic() + deadline if deadline is not None else None
+        )
         job = Job(
             id=next(self._job_ids),
             client=client,
             priority=priority,
-            shape=shape_key(hist),
+            shape=shape,
             fingerprint=fingerprint,
             events=events,
             hist=hist,
             no_viz=no_viz,
             trace_id=trace_id,
+            cancel=cancel,
         )
         fut: asyncio.Future = self._loop.create_future()
 
@@ -877,14 +1070,19 @@ class Verifyd:
         job.resolve = _resolve
         # Write-ahead: the accept record lands before the queue sees the
         # job, so a daemon killed in between owes (and replays) the job
-        # rather than silently dropping an admission the client saw.
+        # rather than silently dropping an admission the client saw.  The
+        # append runs through the journal's DegradedWriter: on a full
+        # disk the job still runs, but the reply says durable=false.
+        durable = False
         if self.journal is not None:
-            self.journal.accept(
-                job=job.id,
-                fingerprint=fingerprint,
-                client=client,
-                priority=priority,
-                history=text,
+            _, durable = self._journal_writer.run(
+                lambda: self.journal.accept(
+                    job=job.id,
+                    fingerprint=fingerprint,
+                    client=client,
+                    priority=priority,
+                    history=text,
+                )
             )
         if self.archive is not None:
             # One corpus entry per fingerprint: the archived workload is
@@ -894,7 +1092,7 @@ class Verifyd:
             depth = self.queue.put(job)
         except QueueFull as e:
             if self.journal is not None:
-                self.journal.reject(job.id)
+                self._journal_writer.run(lambda: self.journal.reject(job.id))
             self.stats.emit(
                 "reject",
                 client=client,
@@ -910,7 +1108,7 @@ class Verifyd:
             )
         except RuntimeError as e:  # queue closed: daemon is stopping
             if self.journal is not None:
-                self.journal.reject(job.id)
+                self._journal_writer.run(lambda: self.journal.reject(job.id))
             return err(ERR_SHUTTING_DOWN, str(e))
         job.enqueued_at = self.tracer.now()
         self.stats.emit(
@@ -955,4 +1153,41 @@ class Verifyd:
                     "trace_id": trace_id,
                 },
             )
-        return await fut
+        reply = await self._await_reply(fut, job, reader)
+        if self.journal is not None and isinstance(reply.get("ok"), dict):
+            # Honest durability: false when the accept record never hit
+            # disk OR the journal degraded while the job ran (the done
+            # record is then also non-durable).
+            reply["ok"]["durable"] = durable and not self._journal_writer.degraded
+        return reply
+
+    async def _await_reply(
+        self,
+        fut: asyncio.Future,
+        job: Job,
+        reader: asyncio.StreamReader | None,
+    ) -> dict:
+        """Wait for the worker's reply while watching the client socket.
+
+        A peer that disconnects mid-submit (EOF or reset on ``reader``)
+        cancels the job with reason ``client_gone`` so no worker stays
+        pinned computing an answer nobody will read — the scheduler
+        notices at its next cancellation boundary, the lease releases,
+        and the (unwritable) reply just fails fast in ``_handle``.  The
+        asyncio transport feeds EOF without a pending read, so polling
+        ``at_eof()`` here never consumes a pipelined frame.
+        """
+        while True:
+            done, _ = await asyncio.wait({fut}, timeout=0.2)
+            if done:
+                return fut.result()
+            if reader is not None and (
+                reader.at_eof() or reader.exception() is not None
+            ):
+                if job.cancel.cancel("client_gone"):
+                    self.stats.emit(
+                        "client_gone",
+                        job=job.id,
+                        client=job.client,
+                        trace_id=job.trace_id,
+                    )

@@ -74,6 +74,14 @@ class JobJournal:
                 }
             )
 
+    def started(self, *, job: int, fingerprint: str) -> None:
+        """A worker picked the job up.  The record is what separates a
+        *poison* orphan (started, then the process died — chargeable to
+        the job) from an innocent one that merely sat in the queue; the
+        quarantine ledger only counts the former."""
+        with self._lock:
+            self._append({"rec": "run", "job": job, "fp": fingerprint})
+
     def reject(self, job: int) -> None:
         """Close an accept whose queue admission was refused (the client
         got the backpressure reply; nothing is owed)."""
@@ -104,8 +112,11 @@ class JobJournal:
     def orphans(self) -> list[dict]:
         """Replay the log; return accept records (any boot) that were
         never closed by a done/reject of the same (boot, job).  Duplicate
-        fingerprints collapse to one re-run (the cache answers the rest)."""
+        fingerprints collapse to one re-run (the cache answers the rest).
+        Each record carries ``started``: whether a worker had picked the
+        job up before the death (the quarantine ledger's poison signal)."""
         open_jobs: dict[tuple[str, int], dict] = {}
+        runs: set[tuple[str, int]] = set()
         for payload in self._log.replay():
             try:
                 rec = json.loads(payload)
@@ -115,12 +126,21 @@ class JobJournal:
             kind = rec.get("rec")
             if kind == "accept":
                 open_jobs[key] = rec
+            elif kind == "run":
+                runs.add(key)
             elif kind in ("done", "reject"):
                 open_jobs.pop(key, None)
+        started_fp = {
+            rec.get("fp", "")
+            for key, rec in open_jobs.items()
+            if key in runs
+        }
         seen_fp: set[str] = set()
         out = []
         for rec in open_jobs.values():
             fp = rec.get("fp", "")
+            # any open duplicate of this fp having started marks them all
+            rec["started"] = fp in started_fp
             if fp in seen_fp:
                 continue
             seen_fp.add(fp)

@@ -16,7 +16,13 @@ Ops:
 ``stats``     → ``{"ok": {<counter snapshot>}}``
 ``submit``    → history JSONL text in ``history``; optional ``client``
                 (string identity), ``priority`` (int, lower = sooner),
-                ``no_viz``, and ``trace`` — a distributed-trace context
+                ``no_viz``, ``deadline`` — remaining end-to-end budget
+                in seconds; the daemon refuses a spent budget with the
+                **definite** ``DeadlineExceeded`` and cooperatively
+                cancels queued/running work when it expires (like
+                ``trace`` below, the field is optional, ignored by old
+                daemons, and HMAC-covered) — and ``trace`` — a
+                distributed-trace context
                 ``{"trace_id": <32 hex>, "sent_wall": <epoch s>}``
                 (obs/context.py).  The field is *optional and ignored by
                 old daemons* (unknown keys pass through untouched, and
@@ -38,6 +44,13 @@ Ops:
                 time), ``limit`` (newest N; defaults to 100 when no
                 other cut is given).  Reply:
                 ``{"ok": {"records": [...], "total": <archived>}}``.
+``quarantine``→ poison-job ledger ops (requires ``--state-dir``):
+                ``action`` = ``list`` (every quarantined fingerprint),
+                ``inspect`` (one entry + live crash count, needs
+                ``fingerprint``), or ``release`` (operator override:
+                un-quarantine + forgive crashes).  Submitting a
+                quarantined history is answered with the **definite**
+                ``Quarantined`` error before admission.
 ``shutdown``  → acks, then stops the daemon.  Optional ``drain``
                 (bool) + ``timeout`` (seconds): stop admitting, let
                 in-flight jobs finish up to the deadline, close the
@@ -106,6 +119,9 @@ __all__ = [
     "ERR_INTERNAL",
     "ERR_SHUTTING_DOWN",
     "ERR_NO_BACKEND",
+    "ERR_DEADLINE",
+    "ERR_QUARANTINED",
+    "ERR_CANCELLED",
     "EXIT_BUSY",
     "EXIT_UNAVAILABLE",
     "EXIT_PROTOCOL",
@@ -134,6 +150,18 @@ ERR_TOO_LARGE = "FrameTooLarge"
 ERR_AUTH = "AuthError"
 ERR_INTERNAL = "InternalError"
 ERR_SHUTTING_DOWN = "ShuttingDown"
+#: Definite: the job's end-to-end deadline passed (at admission, in the
+#: queue, or mid-search).  Retrying without a larger deadline is
+#: pointless, so clients treat it like a semantic refusal, and the
+#: router forwards it instead of failing over.
+ERR_DEADLINE = "DeadlineExceeded"
+#: Definite: the history's fingerprint is quarantined after repeated
+#: process/child deaths.  Answered before admission; an operator
+#: releases it with the ``quarantine`` op.
+ERR_QUARANTINED = "Quarantined"
+#: Definite: the job was cancelled for a non-deadline reason
+#: (``client_gone``, ``shutdown``) after admission.
+ERR_CANCELLED = "Cancelled"
 #: Router-only: every routable backend was tried (or none existed) and
 #: the submit could not be placed.  Transient — clients retry like
 #: :data:`ERR_SHUTTING_DOWN`.

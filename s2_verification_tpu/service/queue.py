@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .overload import CancelToken
+
 __all__ = ["Job", "QueueFull", "AdmissionQueue"]
 
 
@@ -56,6 +58,10 @@ class Job:
     #: monotonic instant the job entered the admission queue (0.0 =
     #: unknown; queue-wait accounting falls back to ``submitted_at``)
     enqueued_at: float = 0.0
+    #: cooperative-cancellation flag (deadline / client_gone / shutdown);
+    #: armed with a deadline by the submit path, polled by the scheduler
+    #: at layer boundaries and by the supervised-child babysitter
+    cancel: CancelToken = field(default_factory=CancelToken)
     #: called exactly once with the reply dict (thread-safe trampoline
     #: into the daemon's event loop)
     resolve: Callable[[dict], None] = lambda _reply: None

@@ -80,6 +80,7 @@ from .client import (
 )
 from .protocol import (
     ERR_AUTH,
+    ERR_DEADLINE,
     ERR_DECODE,
     ERR_FRAME,
     ERR_INTERNAL,
@@ -809,6 +810,22 @@ class VerifydRouter:
                     while len(self._text_fp) > self.cfg.cache_capacity:
                         self._text_fp.popitem(last=False)
 
+        # End-to-end deadline: the client's remaining budget rides the
+        # frame; the router decrements it across failovers so a job that
+        # burned its budget on two dead nodes is not handed a third with
+        # a stale clock.  Expired here → definite DeadlineExceeded.
+        deadline = req.get("deadline")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                self._bump("decode_errors")
+                self._m_decode.inc()
+                return err(
+                    ERR_DECODE, f"deadline must be a number, got {deadline!r}"
+                )
+        t_deadline0 = time.monotonic()
+
         order, stolen = self._candidate_order(fingerprint)
         limit = 1 + max(0, self.cfg.max_failovers)
         attempts = 0
@@ -818,6 +835,18 @@ class VerifydRouter:
         for b in order:
             if attempts >= limit:
                 break
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - (time.monotonic() - t_deadline0)
+                if remaining <= 0:
+                    self.health.observe_event({"ev": "job_error"})
+                    return err(
+                        ERR_DEADLINE,
+                        f"deadline spent after {attempts} attempt(s) "
+                        f"({last_err})",
+                        attempts=attempts,
+                        reason="deadline",
+                    )
             if not b.breaker.allow():
                 self._refresh_breaker_gauge(b)
                 continue
@@ -832,8 +861,15 @@ class VerifydRouter:
                     client=str(req.get("client") or "router"),
                     priority=int(req.get("priority") or 10),
                     no_viz=req.get("no_viz"),
-                    timeout=self.cfg.submit_timeout_s,
+                    timeout=(
+                        self.cfg.submit_timeout_s
+                        if remaining is None
+                        else min(
+                            self.cfg.submit_timeout_s or remaining, remaining
+                        )
+                    ),
                     trace_id=trace_id,
+                    deadline_s=remaining,
                 )
             except VerifydBusy as e:
                 # The node answered: alive, just saturated — steal the
@@ -862,7 +898,9 @@ class VerifydRouter:
                 continue
             except VerifydError as e:
                 # A semantic answer (DecodeError, InternalError,
-                # ShuttingDown): the daemon decided — pass it through.
+                # ShuttingDown — and the definite overload verdicts
+                # Quarantined / DeadlineExceeded / Cancelled): the daemon
+                # decided — pass it through, never fail it over.
                 b.breaker.record_success()
                 if e.cls == ERR_SHUTTING_DOWN:
                     # Draining underneath us: keep it out of the set

@@ -31,7 +31,7 @@ from ..models.encode import _bucket_chains, _bucket_len, round_pow2
 from ..models.stream import APPEND
 from ..obs.introspect import INTROSPECTOR, job_context
 from ..obs.trace import NULL_TRACER, Tracer
-from .protocol import VERDICT_EXIT, err, ok
+from .protocol import ERR_CANCELLED, ERR_DEADLINE, VERDICT_EXIT, err, ok
 from .queue import AdmissionQueue, Job
 from .stats import ServiceStats
 
@@ -114,6 +114,9 @@ class Scheduler:
         profile: bool = False,
         device_pool=None,
         lease_timeout_s: float = 120.0,
+        journal_writer=None,
+        quarantine=None,
+        cancel_grace_s: float = 2.0,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -140,6 +143,14 @@ class Scheduler:
         #: how long an escalation waits for a lease under contention
         #: before falling back to the unsharded path
         self.lease_timeout_s = lease_timeout_s
+        #: DegradedWriter guarding journal appends (None = raw journal);
+        #: lets an ENOSPC'd disk degrade durability instead of erroring
+        self.journal_writer = journal_writer
+        #: poison-job ledger (overload.QuarantineStore); child kills feed
+        #: it live, conclusive verdicts forgive accumulated crashes
+        self.quarantine = quarantine
+        #: SIGTERM→SIGKILL grace for cancelled supervised children
+        self.cancel_grace_s = cancel_grace_s
         self._threads: list[threading.Thread] = []
         self._stopping = False
 
@@ -188,22 +199,65 @@ class Scheduler:
                     )
                 job.resolve(reply)
 
+    def _journal_append(self, job: Job, fn) -> None:
+        """Route a journal append through the DegradedWriter when one is
+        armed (disk-full degrades durability instead of raising)."""
+        if self.journal_writer is not None:
+            self.journal_writer.run(fn)
+            return
+        try:
+            fn()
+        except (OSError, ValueError):
+            log.exception("job %d: journal append failed", job.id)
+
     def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
         if self.journal is None:
             return
-        try:
-            self.journal.done(
+        self._journal_append(
+            job,
+            lambda: self.journal.done(
                 job=job.id,
                 fingerprint=job.fingerprint,
                 verdict=verdict,
                 outcome=outcome,
-            )
-        except (OSError, ValueError):
-            log.exception("job %d: journal done-mark failed", job.id)
+            ),
+        )
+
+    def _cancel_reply(
+        self, job: Job, reason: str, queue_wait: float, *, started: bool
+    ) -> dict:
+        """Answer a cancelled job: close its journal record (the client
+        got — or abandoned — its reply; nothing is owed a replay), count
+        it, and return the definite error."""
+        self._mark_done(job, verdict=None, outcome="cancelled")
+        self.stats.emit(
+            "job_cancelled",
+            job=job.id,
+            client=job.client,
+            reason=reason,
+            started=started,
+            queue_wait_s=round(queue_wait, 4),
+            trace_id=job.trace_id,
+        )
+        cls = ERR_DEADLINE if reason == "deadline" else ERR_CANCELLED
+        return err(
+            cls,
+            f"job {job.id} cancelled ({reason})",
+            job=job.id,
+            reason=reason,
+        )
 
     def _run_job(self, job: Job) -> dict:
         t_pick = time.monotonic()
         queue_wait = t_pick - (job.enqueued_at or job.submitted_at)
+        # Cancellation boundary #1: a job whose deadline passed in the
+        # queue (or whose client hung up / whose daemon is stopping)
+        # never starts — the worker moves straight to live work.
+        if self._stopping:
+            job.cancel.cancel("shutdown")
+        reason = job.cancel.check()
+        if reason is not None:
+            return self._cancel_reply(job, reason, queue_wait, started=False)
         # Duplicate admitted while its twin was still in flight: answer
         # from the verdict cache at execution time too.
         cached = self.cache.get(job.fingerprint)
@@ -229,6 +283,16 @@ class Scheduler:
             )
             return ok(cached)
 
+        # Run record before the search: it is what lets boot-time orphan
+        # recovery distinguish a poison job (started, then the process
+        # died) from one that innocently sat in the queue.
+        if self.journal is not None:
+            self._journal_append(
+                job,
+                lambda: self.journal.started(
+                    job=job.id, fingerprint=job.fingerprint
+                ),
+            )
         warm = self.stats.note_shape(job.shape)
         self.stats.emit(
             "start",
@@ -271,6 +335,17 @@ class Scheduler:
                 "trace_id": job.trace_id,
             },
         )
+
+        # Cancellation boundary #2: a search abandoned mid-flight comes
+        # back UNKNOWN — answer the cancellation, not a fake verdict.  A
+        # conclusive result that beat the cancel is still worth more to
+        # the client than the error, so it wins.
+        reason = job.cancel.check()
+        if reason is not None and res.outcome == CheckOutcome.UNKNOWN:
+            return self._cancel_reply(job, reason, queue_wait, started=True)
+        if self.quarantine is not None and res.outcome != CheckOutcome.UNKNOWN:
+            # A conclusive verdict forgives accumulated crash counts.
+            self.quarantine.note_success(job.fingerprint)
 
         artifact = None
         if not job.no_viz:
@@ -335,14 +410,23 @@ class Scheduler:
 
     def _portfolio(self, job: Job) -> tuple[CheckResult, str]:
         budget = self.time_budget_s
+        # A job deadline bounds every stage: no layer may out-sleep what
+        # the client is still willing to wait for.
+        remaining = job.cancel.remaining()
         if budget is not None and budget <= 0:
             # Budget 0 = run to completion on CPU (the reference's
-            # unbounded default), mirroring cli._run_backend.
-            res, engine = self._traced_cpu(job, None)
+            # unbounded default), mirroring cli._run_backend — unless a
+            # deadline caps it.
+            res, engine = self._traced_cpu(job, remaining)
             return res, f"{engine}-unbounded"
         budget = budget if budget is not None else 10.0
+        if remaining is not None:
+            budget = max(0.05, min(budget, remaining))
         res, engine = self._traced_cpu(job, budget)
         if res.outcome != CheckOutcome.UNKNOWN:
+            return res, engine
+        if job.cancel.check() is not None:
+            # Cancelled during the CPU stage: skip device escalation.
             return res, engine
         if self.device != "off":
             t_dev = time.monotonic()
@@ -364,10 +448,12 @@ class Scheduler:
             self._merge_child_jit(job, dres)
             if dres is not None and dres.outcome != CheckOutcome.UNKNOWN:
                 return dres, dev_backend
+            if job.cancel.check() is not None:
+                return res, engine
             if dres is None:
                 self.stats.emit("degrade", job=job.id, to="cpu")
         if self.unbounded_close:
-            res, engine = self._traced_cpu(job, None)
+            res, engine = self._traced_cpu(job, job.cancel.remaining())
             return res, f"{engine}-unbounded"
         return res, engine
 
@@ -471,12 +557,21 @@ class Scheduler:
         ``device-{mode}`` otherwise."""
         log.info("job %d: CPU budget exhausted; escalating to device", job.id)
         backend = f"device-{self.device}"
+        remaining = job.cancel.remaining()
+        lease_t = self.lease_timeout_s
+        attempt_t = self.attempt_timeout_s
+        if remaining is not None:
+            # Neither the lease wait nor a child attempt may out-live
+            # the job's deadline (plus nothing: the cancel poll frees
+            # the child within grace anyway).
+            lease_t = max(0.05, min(lease_t, remaining))
+            attempt_t = max(0.1, min(attempt_t, remaining))
         lease = None
         if self.device_pool is not None:
             lease = self.device_pool.acquire(
                 shape=job.shape,
                 job=job.id,
-                timeout_s=self.lease_timeout_s,
+                timeout_s=lease_t,
             )
             if lease is not None:
                 backend = f"device-mesh[{lease.size}]"
@@ -489,7 +584,7 @@ class Scheduler:
                 log.warning(
                     "job %d: no device lease within %.1fs; running unsharded",
                     job.id,
-                    self.lease_timeout_s,
+                    lease_t,
                 )
         try:
             if self.device == "inline":
@@ -513,22 +608,31 @@ class Scheduler:
                 return check_device_auto(job.hist, **kw), backend
             from .supervise import supervised_device_check
 
-            return (
-                supervised_device_check(
-                    job.events,
-                    spool_dir=self.spool_dir,
-                    job_id=job.id,
-                    attempt_timeout_s=self.attempt_timeout_s,
-                    max_restarts=self.max_restarts,
-                    device_rows=self.device_rows,
-                    devices=lease.indices if lease is not None else None,
-                    profile=self.profile,
-                    trace_id=job.trace_id,
-                    log=lambda s: log.info("job %d supervise: %s", job.id, s),
-                    tracer=self.tracer,
-                ),
-                backend,
+            dres = supervised_device_check(
+                job.events,
+                spool_dir=self.spool_dir,
+                job_id=job.id,
+                attempt_timeout_s=attempt_t,
+                max_restarts=self.max_restarts,
+                device_rows=self.device_rows,
+                devices=lease.indices if lease is not None else None,
+                profile=self.profile,
+                trace_id=job.trace_id,
+                log=lambda s: log.info("job %d supervise: %s", job.id, s),
+                tracer=self.tracer,
+                cancel=job.cancel.check,
+                grace_s=self.cancel_grace_s,
             )
+            if (
+                dres is None
+                and self.quarantine is not None
+                and job.cancel.check() is None
+            ):
+                # The child died (or wedged past its kill timeout) with
+                # no cancellation of ours to blame: one live crash
+                # charged to this fingerprint in the poison ledger.
+                self.quarantine.note_crash(job.fingerprint, kind="child")
+            return dres, backend
         finally:
             if lease is not None:
                 self.device_pool.release(lease)

@@ -24,7 +24,15 @@ wall-time drift trip — also re-emitted onto the stream, where the alert
 engine routes it); ``retrace_storm`` (the JIT introspector's latched
 per-(site, shape) recompile trip — emitted by
 :data:`~..obs.introspect.INTROSPECTOR` onto this stream, where the
-alert engine routes it like any other signal).
+alert engine routes it like any other signal).  Overload-protection
+events (ISSUE 10): ``job_cancelled`` (cooperative cancellation fired:
+``reason`` = deadline / client_gone / shutdown), ``admission_shed``
+(the AdmissionController refused before queueing: ``reason`` = rss /
+fds / deadline), ``client_gone`` (a submit's TCP peer vanished
+mid-wait), ``job_quarantined`` / ``quarantine_release`` /
+``quarantine_reject`` (poison-job ledger transitions), and
+``writer_degraded`` / ``writer_recovered`` (a durable writer hit
+ENOSPC/OSError and dropped to memory-only / re-armed).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -113,10 +121,24 @@ class ServiceStats:
             "slo_breaches": 0,
             "perf_regressions": 0,
             "retrace_storms": 0,
+            "cancelled": 0,
+            "admission_shed": 0,
+            "quarantined": 0,
+            "quarantine_rejects": 0,
+            "writer_degraded_events": 0,
+            "client_gone": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
         self._shapes_seen: set[str] = set()
+        #: per-shape EWMA of decided wall time (AdmissionController's
+        #: deadline-feasibility input)
+        self._shape_wall: dict[str, float] = {}
+        #: EWMA of device-lease hold time (retry-after's lease-wait term)
+        self._lease_hold_avg = 0.0
+        #: DevicePool the daemon arms so retry_after_hint can fold
+        #: lease-wait estimates in (None when escalation is off)
+        self.device_pool = None
 
         self.registry = registry if registry is not None else MetricsRegistry()
         r = self.registry
@@ -251,6 +273,29 @@ class ServiceStats:
             "Latched retrace-storm trips (a shape recompiling one site "
             "past the threshold)",
         )
+        # Overload protection (ISSUE 10).  Label sets are bounded by
+        # construction: reasons come from fixed vocabularies, writer
+        # names from the four durable writers.
+        self._m_cancelled = r.counter(
+            "verifyd_jobs_cancelled_total",
+            "Jobs cooperatively cancelled after admission, by reason",
+            labelnames=("reason",),
+        )
+        self._m_shed = r.counter(
+            "verifyd_admission_shed_total",
+            "Submits shed before queueing by the admission controller",
+            labelnames=("reason",),
+        )
+        self._m_quarantine_size = r.gauge(
+            "verifyd_quarantine_size",
+            "Fingerprints currently quarantined as poison jobs",
+        )
+        self._m_quarantine_size.set(0)
+        self._m_writer_degraded = r.gauge(
+            "verifyd_writer_degraded",
+            "1 while the named durable writer is degraded to memory-only",
+            labelnames=("writer",),
+        )
         # Resource telemetry (obs/introspect.ResourceSampler sets these).
         self._m_res_rss = r.gauge(
             "verifyd_resource_rss_bytes", "Daemon resident set size"
@@ -383,6 +428,10 @@ class ServiceStats:
                 self._m_lease_wait.observe(float(fields["wait_s"]))
         elif event == "lease_release":
             self._m_devices_leased.set(int(fields.get("in_use", 0)))
+            if "held_s" in fields:
+                held = float(fields["held_s"])
+                prev = self._lease_hold_avg
+                self._lease_hold_avg = held if prev <= 0 else 0.7 * prev + 0.3 * held
         elif event == "lease_timeout":
             self._counters["lease_timeouts"] += 1
             self._m_lease_timeouts.inc()
@@ -419,6 +468,45 @@ class ServiceStats:
                     float(fields["queue_wait_s"]),
                     exemplar=fields.get("trace_id"),
                 )
+        elif event == "job_cancelled":
+            self._counters["cancelled"] += 1
+            reason = str(fields.get("reason", "other"))
+            if reason not in ("deadline", "client_gone", "shutdown"):
+                reason = "other"
+            self._m_cancelled.inc(reason=reason)
+            # Only a job that actually started (emitted `start`) holds a
+            # slot in the active gauge; queue-expiry cancels never did.
+            if fields.get("started"):
+                self._active = max(0, self._active - 1)
+                self._m_active.set(self._active)
+        elif event == "admission_shed":
+            self._counters["submitted"] += 1
+            self._counters["admission_shed"] += 1
+            self._m_submitted.inc()
+            reason = str(fields.get("reason", "other"))
+            if reason not in ("rss", "fds", "deadline"):
+                reason = "other"
+            self._m_shed.inc(reason=reason)
+        elif event == "job_quarantined":
+            self._counters["quarantined"] += 1
+            self._m_quarantine_size.set(int(fields.get("size", 0)))
+        elif event == "quarantine_release":
+            self._m_quarantine_size.set(int(fields.get("size", 0)))
+        elif event == "quarantine_reject":
+            self._counters["submitted"] += 1
+            self._counters["quarantine_rejects"] += 1
+            self._m_submitted.inc()
+        elif event == "writer_degraded":
+            self._counters["writer_degraded_events"] += 1
+            self._m_writer_degraded.set(
+                1, writer=str(fields.get("writer", "?"))
+            )
+        elif event == "writer_recovered":
+            self._m_writer_degraded.set(
+                0, writer=str(fields.get("writer", "?"))
+            )
+        elif event == "client_gone":
+            self._counters["client_gone"] += 1
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)
@@ -430,6 +518,12 @@ class ServiceStats:
             self._m_active.set(self._active)
             wall = float(fields.get("wall_s", 0.0))
             self._wall_total_s += wall
+            shape = fields.get("shape")
+            if shape:
+                prev = self._shape_wall.get(str(shape))
+                self._shape_wall[str(shape)] = (
+                    wall if prev is None else 0.7 * prev + 0.3 * wall
+                )
             v = fields.get("verdict")
             name = {0: "verdict_ok", 1: "verdict_illegal", 2: "verdict_unknown"}.get(v)
             if name is not None:
@@ -454,6 +548,11 @@ class ServiceStats:
                     float(s.get("collective_wall_s", 0.0)), shard=shard
                 )
                 self._m_shard_skew.set(float(s.get("skew", 1.0)), shard=shard)
+
+    def set_quarantine_size(self, size: int) -> None:
+        """Boot-time (re)sync of the quarantine gauge with the persisted
+        ledger; live transitions ride the event stream."""
+        self._m_quarantine_size.set(int(size))
 
     def set_queue_depth(self, depth: int) -> None:
         """Point-in-time admission-queue depth (daemon after put, workers
@@ -497,15 +596,29 @@ class ServiceStats:
         with self._lock:
             return self._active
 
+    def predicted_wall_s(self, shape: str) -> float:
+        """EWMA of decided wall time for ``shape`` (0.0 = never seen) —
+        the AdmissionController's deadline-feasibility input."""
+        with self._lock:
+            return self._shape_wall.get(str(shape), 0.0)
+
     def retry_after_hint(self, queue_depth: int) -> float:
         """Backpressure hint: roughly how long until the queue has room —
-        (queued + in-flight jobs) × average decided-job wall time, clamped
-        to [0.5, 30] s (a cold daemon has no average yet; never tell a
-        client "0").  In-flight jobs count because under full concurrency
-        a deep queue behind busy workers drains no faster than the
-        workers finish."""
+        (queued + in-flight jobs) × average decided-job wall time, plus
+        the device pool's lease-wait backlog (waiters × EWMA lease hold:
+        jobs parked in supervised escalation drain no faster than leases
+        turn over, and a hint that ignored them taught clients to
+        dogpile a wedged mesh), clamped to [0.5, 30] s (a cold daemon
+        has no average yet; never tell a client "0")."""
         with self._lock:
             done = self._counters["completed"]
             avg = (self._wall_total_s / done) if done else 1.0
             pending = queue_depth + self._active
-        return round(min(30.0, max(0.5, pending * avg)), 2)
+            hold = self._lease_hold_avg
+        extra = 0.0
+        if self.device_pool is not None and hold > 0:
+            try:
+                extra = self.device_pool.snapshot().get("waiters", 0) * hold
+            except Exception:
+                extra = 0.0
+        return round(min(30.0, max(0.5, pending * avg + extra)), 2)

@@ -95,6 +95,8 @@ def supervised_device_check(
     probe: bool | None = None,
     log=None,
     tracer=None,
+    cancel=None,
+    grace_s: float = 5.0,
 ) -> CheckResult | None:
     """Run the device search for ``events`` under supervision.
 
@@ -116,6 +118,12 @@ def supervised_device_check(
     names, a restart after a re-grant onto a *different* chip set resumes
     the same snapshot.  ``profile=True`` makes the child record the
     per-segment timeline (rides back in the result JSON).
+
+    ``cancel`` (a ``() -> reason | None`` callable, the job's
+    CancelToken poll) is threaded into the driver: a cancelled job
+    SIGTERMs the child, waits ``grace_s`` for a clean exit, SIGKILLs it
+    otherwise, and returns ``None`` with no relaunch — the lease
+    releases through the scheduler's normal ``finally``.
     """
     from ..checker.resilient import default_probe_cmd, drive
     from ..obs.trace import NULL_TRACER
@@ -158,6 +166,8 @@ def supervised_device_check(
             log=log,
             tracer=tracer if tracer is not None else NULL_TRACER,
             trace_tid=job_id,
+            cancel=cancel,
+            grace_s=grace_s,
         )
         if not outcome.ok:
             return None

@@ -55,7 +55,15 @@ What it checks (the `make obs` gate):
     duplicate from its edge cache, and return ONE stitched trace export
     in which a routed job's ``trace_id`` appears on the router's pid AND
     a backend's remapped pid — router → daemon → supervised child on a
-    single Perfetto timeline.
+    single Perfetto timeline;
+16. overload protection: after driving one of each transition for real
+    (a spent deadline shed at admission, a mid-search deadline cancel, a
+    crash-ledger quarantine + reject + release, an injected-ENOSPC
+    journal degrade), the scrape must carry
+    ``verifyd_jobs_cancelled_total``, ``verifyd_admission_shed_total``,
+    ``verifyd_quarantine_size``, and ``verifyd_writer_degraded`` with
+    every label value drawn from its bounded set — reasons and writer
+    names are enums, never payload-derived.
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
 Pure stdlib + the package; runs on CPU in under a minute.
@@ -134,6 +142,19 @@ REQUIRED_ROUTER_FAMILIES = (
     "verifyd_router_jobs_total",
     "verifyd_router_cache_hits_total",
 )
+
+#: overload-protection families (PR 10) and the bounded label sets the
+#: stats layer folds arbitrary event fields into — cardinality is an
+#: enum by construction, and the check fails if a new value leaks in
+REQUIRED_OVERLOAD_FAMILIES = (
+    "verifyd_jobs_cancelled_total",
+    "verifyd_admission_shed_total",
+    "verifyd_quarantine_size",
+    "verifyd_writer_degraded",
+)
+CANCEL_REASONS = {"deadline", "client_gone", "shutdown", "other"}
+SHED_REASONS = {"rss", "fds", "deadline", "other"}
+DEGRADED_WRITERS = {"journal", "cache", "archive", "flight"}
 
 #: one OpenMetrics exemplar suffix: `` # {trace_id="<32 hex>"} <v> <ts>``
 EXEMPLAR_RE = r'# \{trace_id="([0-9a-f]{32})"\} [0-9.eE+-]+ [0-9.]+$'
@@ -1212,6 +1233,168 @@ def main() -> int:
     finally:
         sched_mod._cpu_check = real_cpu_check
 
+    # -- overload phase: the four protection families, bounded labels -------
+    # Drive one of each transition for real — a spent deadline shed at
+    # admission, a mid-search deadline cancel, a crash-ledger quarantine
+    # (reject + release), an injected-ENOSPC journal degrade — then hold
+    # the scrape to the enum label sets.
+    import re as _re
+    import time as _ovl_time
+
+    from s2_verification_tpu.checker.entries import prepare as _prepare
+    from s2_verification_tpu.service.cache import history_fingerprint
+
+    def _ovl_sleepy(hist, budget, profile=False):
+        _ovl_time.sleep(min(budget if budget is not None else 0.5, 2.0))
+        return CheckResult(CheckOutcome.UNKNOWN), "native"
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-overload-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            fault = os.path.join(d, "fault")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="off",
+                metrics_port=0,
+                state_dir=os.path.join(d, "state"),
+                quarantine_threshold=2,
+                time_budget_s=30.0,
+                deadline_grace_s=1.0,
+            )
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                try:
+                    client.submit(texts[0], client="ovl", deadline_s=0.0)
+                    return _fail("overload: a spent deadline was admitted")
+                except VerifydError as e:
+                    if e.cls != "DeadlineExceeded":
+                        return _fail(
+                            f"overload: shed answered {e.cls}, want "
+                            "DeadlineExceeded"
+                        )
+                sched_mod._cpu_check = _ovl_sleepy
+                try:
+                    client.submit(texts[0], client="ovl", deadline_s=0.3)
+                    return _fail("overload: doomed mid-search job answered")
+                except VerifydError as e:
+                    if e.cls != "DeadlineExceeded":
+                        return _fail(
+                            f"overload: cancel answered {e.cls}, want "
+                            "DeadlineExceeded"
+                        )
+                sched_mod._cpu_check = real_cpu_check
+                fp = history_fingerprint(
+                    _prepare(
+                        list(ev.iter_history(texts[1])), elide_trivial=True
+                    )
+                )
+                daemon.quarantine.note_crash(fp)
+                daemon.quarantine.note_crash(fp)
+                if not daemon.quarantine.is_quarantined(fp):
+                    return _fail(
+                        "overload: two crashes at threshold 2 never "
+                        "quarantined"
+                    )
+                try:
+                    client.submit(texts[1], client="ovl")
+                    return _fail(
+                        "overload: quarantined fingerprint was admitted"
+                    )
+                except VerifydError as e:
+                    if e.cls != "Quarantined":
+                        return _fail(
+                            f"overload: reject answered {e.cls}, want "
+                            "Quarantined"
+                        )
+                daemon.quarantine.release(fp)
+                with open(fault, "w") as f:
+                    f.write("journal")
+                os.environ["VERIFYD_FAULT_ENOSPC_FILE"] = fault
+                try:
+                    reply = client.submit(texts[2], client="ovl")
+                finally:
+                    os.environ.pop("VERIFYD_FAULT_ENOSPC_FILE", None)
+                if reply.get("durable") is not False:
+                    return _fail(
+                        f"overload: reply through a dead journal still "
+                        f"claims durability: {reply}"
+                    )
+                ovl_body = (
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{daemon.metrics_port}/metrics",
+                        timeout=5,
+                    )
+                    .read()
+                    .decode("utf-8")
+                )
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+        os.environ.pop("VERIFYD_FAULT_ENOSPC_FILE", None)
+
+    ovl_fams = _parse_families(ovl_body)
+    missing = [f for f in REQUIRED_OVERLOAD_FAMILIES if f not in ovl_fams]
+    if missing:
+        return _fail(f"overload families missing from scrape: {missing}")
+
+    def _label_values(family: str, label: str) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for line in ovl_body.splitlines():
+            m = _re.match(
+                rf'^{family}\{{.*?{label}="([^"]*)".*?\}} ([0-9.eE+-]+)$',
+                line,
+            )
+            if m:
+                out[m.group(1)] = out.get(m.group(1), 0.0) + float(m.group(2))
+        return out
+
+    cancel_reasons = _label_values("verifyd_jobs_cancelled_total", "reason")
+    shed_reasons = _label_values("verifyd_admission_shed_total", "reason")
+    degraded_writers = _label_values("verifyd_writer_degraded", "writer")
+    if not set(cancel_reasons) <= CANCEL_REASONS:
+        return _fail(
+            f"verifyd_jobs_cancelled_total reason cardinality leaked: "
+            f"{sorted(set(cancel_reasons) - CANCEL_REASONS)}"
+        )
+    if not set(shed_reasons) <= SHED_REASONS:
+        return _fail(
+            f"verifyd_admission_shed_total reason cardinality leaked: "
+            f"{sorted(set(shed_reasons) - SHED_REASONS)}"
+        )
+    if not set(degraded_writers) <= DEGRADED_WRITERS:
+        return _fail(
+            f"verifyd_writer_degraded writer cardinality leaked: "
+            f"{sorted(set(degraded_writers) - DEGRADED_WRITERS)}"
+        )
+    if cancel_reasons.get("deadline", 0) < 1:
+        return _fail(
+            f"jobs_cancelled_total{{reason=deadline}} never counted: "
+            f"{cancel_reasons}"
+        )
+    if shed_reasons.get("deadline", 0) < 1:
+        return _fail(
+            f"admission_shed_total{{reason=deadline}} never counted: "
+            f"{shed_reasons}"
+        )
+    if degraded_writers.get("journal") != 1:
+        return _fail(
+            f"writer_degraded{{writer=journal}} gauge not 1 while "
+            f"degraded: {degraded_writers}"
+        )
+    qsize_lines = [
+        line
+        for line in ovl_body.splitlines()
+        if line.startswith("verifyd_quarantine_size")
+        and not line.startswith("#")
+    ]
+    if not qsize_lines or float(qsize_lines[0].rsplit(" ", 1)[1]) != 0:
+        return _fail(
+            f"verifyd_quarantine_size not rendered as 0 after release: "
+            f"{qsize_lines}"
+        )
+
     print(
         f"obs check OK: {len(REQUIRED_FAMILIES)} metric families, "
         f"{len(spans)} spans, {len(profiled)} profiled jobs, "
@@ -1229,7 +1412,10 @@ def main() -> int:
         f"resource sample(s) off a SIGKILLed daemon, "
         f"{len(REQUIRED_ROUTER_FAMILIES)} router families over "
         f"{len(backend_labels)} backends with one trace stitched across "
-        f"{len(fleet_pids)} pids"
+        f"{len(fleet_pids)} pids, {len(REQUIRED_OVERLOAD_FAMILIES)} "
+        f"overload families with bounded labels (cancel "
+        f"{sorted(cancel_reasons)}, shed {sorted(shed_reasons)}, degraded "
+        f"{sorted(degraded_writers)})"
     )
     return 0
 

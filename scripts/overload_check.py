@@ -1,0 +1,615 @@
+"""Overload-protection gate: prove the failure-containment story end to end.
+
+Four phases, each a hard assertion (the `make overload` gate):
+
+1. **Poison-job quarantine** — a CPU-intractable history (the k-way
+   adversarial construction) is in flight each time a *subprocess*
+   daemon is SIGKILLed.  Within 3 boots the fingerprint's crash count
+   crosses the threshold and the journal replay quarantines it instead
+   of re-entering the crash loop; an innocent job sharing the same
+   journal replays and answers its one-shot verdict on every boot
+   (zero impact on concurrent jobs).  `quarantine list`/`release`
+   (protocol op AND CLI subcommand) re-admit it.
+2. **End-to-end deadline** — a job with a 2 s deadline against a
+   deliberately intractable configuration (tiny CPU budget, supervised
+   escalation into a child wedged at interpreter startup) frees its
+   worker, SIGTERMs the child, and releases its device lease within
+   deadline + grace; the client gets a definite ``DeadlineExceeded``
+   and ``verifyd_jobs_cancelled_total{reason="deadline"}`` counts it.
+3. **Disk-full degradation** — injected ENOSPC on the admission journal
+   (``VERIFYD_FAULT_ENOSPC_FILE``) flips the daemon to explicit
+   non-durable mode: replies carry ``durable: false``, ``/healthz``
+   answers 503 with a machine-readable reason, the ``writer_degraded``
+   builtin alert delivers to a webhook — and no in-flight job is
+   dropped.  Clearing the fault re-arms durability.
+4. **Admission-controller overhead** — ``service_bench`` with
+   ``--max-rss-frac`` armed must stay within 3% of an identical
+   disarmed run (and is reported against the published
+   ``service_jobs_per_sec`` baseline).
+
+Exit 0 when every assertion holds; 1 with the failures on stderr.
+One JSON summary line lands on stdout.
+
+Usage:
+    python scripts/overload_check.py [--skip-bench]
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.server
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.adversarial import adversarial_events
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold  # tests/helpers.py: the history builder
+
+#: crash threshold for phase 1 — quarantined on the *third* boot
+QUARANTINE_THRESHOLD = 2
+
+#: adversarial hardness: k=10 is UNKNOWN under any small budget on CPU
+#: (native honors the budget within ~0.2 s) yet generates instantly
+ADVERSARIAL_K = 10
+
+
+def _child_env() -> dict:
+    """Subprocess env: force the CPU backend and *prepend* the repo to
+    PYTHONPATH — the ambient entries (e.g. a PJRT plugin's sitecustomize
+    dir) must survive."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + (
+        (os.pathsep + env["PYTHONPATH"]) if env.get("PYTHONPATH") else ""
+    )
+    return env
+
+
+def _fail(msg: str) -> str:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return msg
+
+
+def _text_of(events) -> str:
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    return buf.getvalue()
+
+
+def _small_history(base: int) -> str:
+    h = H()
+    h.append_ok(1, [base + 1], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+    return _text_of(h.events)
+
+
+def _fingerprint(text: str) -> str:
+    return history_fingerprint(
+        prepare(list(ev.iter_history(text)), elide_trivial=True)
+    )
+
+
+def _write_wedge(d: str) -> str:
+    """A sitecustomize.py that wedges ONLY supervise children: the child
+    is the one ``python -m`` invocation whose argv carries the
+    ``.ckpt.npz`` checkpoint path (visible at site-import time)."""
+    wedge = os.path.join(d, "wedge")
+    os.makedirs(wedge, exist_ok=True)
+    with open(os.path.join(wedge, "sitecustomize.py"), "w") as f:
+        f.write(
+            "import os, sys, time\n"
+            "if os.environ.get('VERIFYD_TEST_WEDGE_CHILD') == '1' and any(\n"
+            "    str(a).endswith('.ckpt.npz')\n"
+            "    for a in getattr(sys, 'argv', [])\n"
+            "):\n"
+            "    time.sleep(300)\n"
+        )
+    return wedge
+
+
+# -- phase 1: quarantine across subprocess SIGKILLs ---------------------------
+
+
+def _spawn_daemon(sock: str, state: str, tmp: str, *extra: str):
+    env = _child_env()
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "s2_verification_tpu", "serve",
+            "-socket", sock,
+            "--workers", "1",
+            "-no-viz",
+            "--state-dir", state,
+            "--stats-log", "",
+            "-out-dir", os.path.join(tmp, "viz"),
+            "--quarantine-threshold", str(QUARANTINE_THRESHOLD),
+            *extra,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode} at boot")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError("daemon socket never appeared")
+        time.sleep(0.05)
+    return proc
+
+
+def _sigkill(proc, sock: str) -> None:
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    try:
+        os.remove(sock)  # SIGKILL leaves the file; serve refuses a stale one
+    except OSError:
+        pass
+
+
+def _submit_bg(sock: str, text: str, name: str) -> threading.Thread:
+    """Fire-and-forget submit: the daemon will be SIGKILLed underneath
+    it, so the reply (an OSError, usually) is deliberately dropped."""
+
+    def run():
+        try:
+            VerifydClient(sock, timeout=600).submit(
+                text, client=name, no_viz=True
+            )
+        except (VerifydError, OSError):
+            pass
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t
+
+
+def _poll_stats(sock: str, want, what: str, timeout_s: float = 60.0) -> dict:
+    deadline = time.monotonic() + timeout_s
+    last: dict = {}
+    while time.monotonic() < deadline:
+        try:
+            last = VerifydClient(sock, timeout=10).stats()
+            if want(last):
+                return last
+        except (VerifydError, OSError):
+            pass
+        time.sleep(0.05)
+    raise RuntimeError(f"timed out waiting for {what}: {last}")
+
+
+def phase_quarantine(failures: list[str]) -> dict:
+    from s2_verification_tpu.cli import main as cli_main
+
+    tmp = tempfile.mkdtemp(prefix="overload-quarantine-")
+    state = os.path.join(tmp, "state")
+    sock = os.path.join(tmp, "verifyd.sock")
+    poison = _text_of(adversarial_events(ADVERSARIAL_K))
+    innocent = _small_history(500)
+    poison_fp = _fingerprint(poison)
+    innocent_path = os.path.join(tmp, "innocent.jsonl")
+    with open(innocent_path, "w") as f:
+        f.write(innocent)
+    truth = cli_main(["check", "-file", innocent_path, "-no-viz"])
+
+    # Boots 1 and 2: the poison job is mid-search (journal `run` record
+    # written, `stats.active` >= 1) when the SIGKILL lands; the innocent
+    # job is accepted into the same journal and never gets to run.
+    crash_flags = ("--device", "off", "--time-budget", "60")
+    proc = _spawn_daemon(sock, state, tmp, *crash_flags)
+    _submit_bg(sock, poison, "poison")
+    _poll_stats(sock, lambda s: s["active"] >= 1, "poison job started")
+    _submit_bg(sock, innocent, "innocent")
+    _poll_stats(sock, lambda s: s["admitted"] >= 2, "innocent accepted")
+    _sigkill(proc, sock)
+
+    proc = _spawn_daemon(sock, state, tmp, *crash_flags)
+    _poll_stats(
+        sock,
+        lambda s: s["orphans_recovered"] >= 2 and s["active"] >= 1,
+        "orphans replayed, poison restarted",
+    )
+    _sigkill(proc, sock)
+
+    # Boot 3: the second charged crash crosses the threshold — the
+    # poison fingerprint is quarantined instead of replayed; the
+    # innocent orphan completes with its one-shot verdict.
+    proc = _spawn_daemon(
+        sock, state, tmp, "--device", "off", "--time-budget", "0.5"
+    )
+    try:
+        snap = _poll_stats(
+            sock, lambda s: s["completed"] >= 1, "innocent orphan completed"
+        )
+        if snap["quarantined"] < 1:
+            failures.append(_fail(
+                f"quarantine: third boot never quarantined the poison "
+                f"fingerprint (counters: {snap})"
+            ))
+        client = VerifydClient(sock, timeout=60)
+        reply = client.submit(innocent, client="retry", no_viz=True)
+        if reply["verdict"] != truth or not reply.get("cached"):
+            failures.append(_fail(
+                f"quarantine: innocent bystander not answered warm with the "
+                f"one-shot verdict {truth}: {reply}"
+            ))
+        try:
+            client.submit(poison, client="retry", no_viz=True)
+            failures.append(_fail("quarantine: poison resubmit was admitted"))
+        except VerifydError as e:
+            if e.cls != "Quarantined":
+                failures.append(_fail(
+                    f"quarantine: poison resubmit got {e.cls}, not Quarantined"
+                ))
+        listing = client.quarantine("list")
+        listed = [e["fingerprint"] for e in listing["entries"]]
+        if listed != [poison_fp]:
+            failures.append(_fail(
+                f"quarantine: list op shows {listed}, want [{poison_fp}]"
+            ))
+
+        # Operator loop through the *CLI* (subprocess: the real argv
+        # surface): list must show the fingerprint, release re-admits.
+        out = subprocess.run(
+            [sys.executable, "-m", "s2_verification_tpu",
+             "quarantine", "list", "--socket", sock],
+            env=_child_env(),
+            capture_output=True, text=True, timeout=60,
+        )
+        if out.returncode != 0 or poison_fp[:12] not in out.stdout:
+            failures.append(_fail(
+                f"quarantine: CLI list rc={out.returncode} "
+                f"stdout={out.stdout!r}"
+            ))
+        out = subprocess.run(
+            [sys.executable, "-m", "s2_verification_tpu",
+             "quarantine", "release", poison_fp, "--socket", sock],
+            env=_child_env(),
+            capture_output=True, text=True, timeout=60,
+        )
+        if out.returncode != 0:
+            failures.append(_fail(
+                f"quarantine: CLI release rc={out.returncode} "
+                f"stderr={out.stderr!r}"
+            ))
+        reply = client.submit(poison, client="released", no_viz=True)
+        if reply.get("verdict") not in (0, 1, 2):
+            failures.append(_fail(
+                f"quarantine: released fingerprint did not run: {reply}"
+            ))
+        if client.quarantine("list")["entries"]:
+            failures.append(_fail("quarantine: entry survived its release"))
+        return {
+            "boots": 3,
+            "threshold": QUARANTINE_THRESHOLD,
+            "poison_fp": poison_fp,
+            "innocent_verdict": truth,
+        }
+    finally:
+        try:
+            VerifydClient(sock, timeout=10).shutdown()
+            proc.wait(timeout=30)
+        except (VerifydError, OSError, subprocess.TimeoutExpired):
+            proc.kill()
+            proc.wait()
+
+
+# -- phase 2: deadline frees worker + child + lease ---------------------------
+
+
+def phase_deadline(failures: list[str]) -> dict:
+    deadline_s, grace_s, slack_s = 2.0, 1.0, 5.0
+    tmp = tempfile.mkdtemp(prefix="overload-deadline-")
+    wedge = _write_wedge(tmp)
+    old_pp = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = (
+        wedge + ((os.pathsep + old_pp) if old_pp else "")
+    )
+    os.environ["VERIFYD_TEST_WEDGE_CHILD"] = "1"
+    try:
+        cfg = VerifydConfig(
+            socket_path=os.path.join(tmp, "verifyd.sock"),
+            workers=1,
+            device="supervised",
+            mesh_devices=1,
+            spool_dir=os.path.join(tmp, "spool"),
+            time_budget_s=0.1,
+            attempt_timeout_s=120.0,
+            deadline_grace_s=grace_s,
+            out_dir=os.path.join(tmp, "viz"),
+            no_viz=True,
+            stats_log=None,
+        )
+        with Verifyd(cfg) as daemon:
+            client = VerifydClient(cfg.socket_path, timeout=120)
+            text = _text_of(adversarial_events(ADVERSARIAL_K, seed=3))
+            t0 = time.monotonic()
+            try:
+                reply = client.submit(text, no_viz=True, deadline_s=deadline_s)
+                failures.append(_fail(
+                    f"deadline: intractable job answered a verdict: {reply}"
+                ))
+                elapsed = time.monotonic() - t0
+            except VerifydError as e:
+                elapsed = time.monotonic() - t0
+                if e.cls != "DeadlineExceeded":
+                    failures.append(_fail(
+                        f"deadline: got {e.cls}, want DeadlineExceeded"
+                    ))
+            if elapsed > deadline_s + grace_s + slack_s:
+                failures.append(_fail(
+                    f"deadline: worker freed after {elapsed:.2f}s "
+                    f"(> {deadline_s} + {grace_s} grace + {slack_s} slack)"
+                ))
+            pool = daemon.device_pool.snapshot()
+            if pool["in_use"] != 0:
+                failures.append(_fail(
+                    f"deadline: device lease never released: {pool}"
+                ))
+            cancelled = daemon.registry.get(
+                "verifyd_jobs_cancelled_total"
+            ).value(reason="deadline")
+            if cancelled < 1:
+                failures.append(_fail(
+                    "deadline: verifyd_jobs_cancelled_total"
+                    '{reason="deadline"} never counted'
+                ))
+            return {"elapsed_s": round(elapsed, 3), "cancelled": cancelled}
+    finally:
+        if old_pp is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = old_pp
+        os.environ.pop("VERIFYD_TEST_WEDGE_CHILD", None)
+
+
+# -- phase 3: ENOSPC degrades durability, never drops a job -------------------
+
+
+class _Webhook:
+    def __init__(self):
+        self.alerts: list[dict] = []
+        recv = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 - stdlib handler name
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                try:
+                    recv.alerts.extend(json.loads(body.decode("utf-8")))
+                except ValueError:
+                    pass
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}/alert"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def _healthz(port: int) -> tuple[int, dict]:
+    try:
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        )
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode("utf-8"))
+
+
+def phase_enospc(failures: list[str]) -> dict:
+    tmp = tempfile.mkdtemp(prefix="overload-enospc-")
+    fault = os.path.join(tmp, "fault")
+    recv = _Webhook()
+    try:
+        cfg = VerifydConfig(
+            socket_path=os.path.join(tmp, "verifyd.sock"),
+            workers=1,
+            device="off",
+            time_budget_s=10.0,
+            out_dir=os.path.join(tmp, "viz"),
+            no_viz=True,
+            stats_log=None,
+            state_dir=os.path.join(tmp, "state"),
+            metrics_port=0,
+            alert_url=recv.url,
+            alert_dedup_s=0.0,
+        )
+        with Verifyd(cfg) as daemon:
+            daemon._journal_writer.reprobe_s = 0.2
+            client = VerifydClient(cfg.socket_path, timeout=60)
+            port = daemon.metrics_port
+
+            r1 = client.submit(_small_history(600), client="pre")
+            if r1.get("durable") is not True:
+                failures.append(_fail(f"enospc: healthy reply not durable: {r1}"))
+            code, _ = _healthz(port)
+            if code != 200:
+                failures.append(_fail(f"enospc: healthy /healthz = {code}"))
+
+            # Inject: every journal append now raises ENOSPC.  The job
+            # submitted *during* the fault still runs to a verdict — the
+            # daemon only stops promising durability.
+            with open(fault, "w") as f:
+                f.write("journal")
+            os.environ["VERIFYD_FAULT_ENOSPC_FILE"] = fault
+            r2 = client.submit(_small_history(601), client="mid")
+            if r2.get("verdict") != 0:
+                failures.append(_fail(f"enospc: in-flight job dropped: {r2}"))
+            if r2.get("durable") is not False:
+                failures.append(_fail(
+                    f"enospc: degraded reply still claims durable: {r2}"
+                ))
+            code, body = _healthz(port)
+            reasons = body.get("reasons", [])
+            if code != 503 or not any(
+                r.get("kind") == "degraded" and r.get("what") == "journal"
+                for r in reasons
+            ):
+                failures.append(_fail(
+                    f"enospc: /healthz {code} lacks the degraded-journal "
+                    f"reason: {reasons}"
+                ))
+            scrape = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode("utf-8")
+            if 'verifyd_writer_degraded{writer="journal"} 1' not in scrape:
+                failures.append(_fail(
+                    "enospc: verifyd_writer_degraded{writer=\"journal\"} "
+                    "gauge not 1 while degraded"
+                ))
+            daemon.alerts.flush(timeout=15.0)
+            names = {a["labels"]["alertname"] for a in recv.alerts}
+            if "writer_degraded" not in names:
+                failures.append(_fail(
+                    f"enospc: writer_degraded alert never delivered "
+                    f"(got: {sorted(names)})"
+                ))
+
+            # Clear the fault: the next append past the reprobe window
+            # lands, durability re-arms, health recovers.
+            os.remove(fault)
+            time.sleep(0.3)
+            r3 = client.submit(_small_history(602), client="post")
+            if r3.get("durable") is not True:
+                failures.append(_fail(
+                    f"enospc: durability never re-armed after recovery: {r3}"
+                ))
+            code, _ = _healthz(port)
+            if code != 200:
+                failures.append(_fail(
+                    f"enospc: /healthz stuck degraded after recovery: {code}"
+                ))
+            snap = daemon.stats.snapshot()
+            return {
+                "writer_degraded_events": snap["writer_degraded_events"],
+                "alerts": sorted(names),
+            }
+    finally:
+        os.environ.pop("VERIFYD_FAULT_ENOSPC_FILE", None)
+        recv.close()
+
+
+# -- phase 4: admission controller costs nothing on the happy path ------------
+
+
+def _bench(hist_dir: str, max_rss_frac: float) -> float:
+    out = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO, "scripts", "service_bench.py"),
+            "--histories", hist_dir, "--seed-collect",
+            "--max-rss-frac", str(max_rss_frac),
+        ],
+        env=_child_env(),
+        capture_output=True, text=True, timeout=600,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"service_bench rc={out.returncode}: {out.stderr[-500:]}"
+        )
+    for line in out.stdout.splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "service_jobs_per_sec":
+            return float(row["value"])
+    raise RuntimeError(f"no service_jobs_per_sec row in: {out.stdout!r}")
+
+
+def phase_bench(failures: list[str]) -> dict:
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from service_bench import _published_baseline
+
+    hist_dir = os.path.join(
+        tempfile.mkdtemp(prefix="overload-bench-"), "hist"
+    )
+    control = _bench(hist_dir, 0.0)
+    armed = _bench(hist_dir, 0.95)
+    ratio = armed / control if control else 0.0
+    if ratio < 0.97:
+        # One retry pair: serving benches on shared machines are noisy;
+        # the gate compares best-of-two per configuration.
+        control = max(control, _bench(hist_dir, 0.0))
+        armed = max(armed, _bench(hist_dir, 0.95))
+        ratio = armed / control if control else 0.0
+    if ratio < 0.97:
+        failures.append(_fail(
+            f"bench: armed AdmissionController costs too much: "
+            f"{armed:.2f} vs {control:.2f} jobs/s (ratio {ratio:.3f} < 0.97)"
+        ))
+    baseline = _published_baseline()
+    vs_published = (armed / baseline) if baseline else None
+    return {
+        "armed_jps": round(armed, 2),
+        "control_jps": round(control, 2),
+        "ratio": round(ratio, 3),
+        "vs_published": round(vs_published, 3) if vs_published else None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-bench", action="store_true",
+                    help="skip the service_bench overhead phase")
+    args = ap.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    failures: list[str] = []
+    summary: dict = {}
+    for name, phase in (
+        ("quarantine", phase_quarantine),
+        ("deadline", phase_deadline),
+        ("enospc", phase_enospc),
+    ):
+        print(f"# phase: {name}", file=sys.stderr)
+        try:
+            summary[name] = phase(failures)
+        except Exception as e:  # a phase crash is a failure, not an abort
+            failures.append(_fail(f"{name}: {type(e).__name__}: {e}"))
+    if not args.skip_bench:
+        print("# phase: bench", file=sys.stderr)
+        try:
+            summary["bench"] = phase_bench(failures)
+        except Exception as e:
+            failures.append(_fail(f"bench: {type(e).__name__}: {e}"))
+
+    summary["failures"] = failures
+    print(json.dumps(summary))
+    if failures:
+        print(f"overload check: {len(failures)} failure(s)", file=sys.stderr)
+        return 1
+    print("overload check OK: quarantine within 3 boots, deadline freed "
+          "worker+lease, ENOSPC degraded without dropping jobs"
+          + ("" if args.skip_bench else ", admission overhead in band"),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,6 +122,11 @@ def main() -> int:
                     "to CPU) and report the mesh serving row "
                     "service_mesh_jobs_per_sec next to the published "
                     "service_jobs_per_sec baseline")
+    ap.add_argument("--max-rss-frac", type=float, default=0.0,
+                    help="in-process daemon only: arm the pressure-aware "
+                    "AdmissionController at this RSS watermark (0 "
+                    "disables) — the overload gate uses this to prove "
+                    "the controller costs nothing on the happy path")
     ap.add_argument("--fleet", type=int, default=None, metavar="N",
                     help="spawn N verifyd backend *processes* behind an "
                     "in-process router (consistent-hash cache affinity, "
@@ -250,6 +255,7 @@ def main() -> int:
                 stats_log=None,
                 metrics_port=args.metrics_port,
                 mesh_devices=args.mesh_devices,
+                max_rss_frac=args.max_rss_frac,
             )
         )
         daemon_ctx.__enter__()

@@ -109,7 +109,13 @@ def test_parse_rule_rejects_nonsense(spec):
 
 def test_builtin_rules_page_on_breach_and_regression():
     names = {r.name for r in builtin_rules()}
-    assert names == {"slo_breach", "perf_regression", "retrace_storm"}
+    assert names == {
+        "slo_breach",
+        "perf_regression",
+        "retrace_storm",
+        "job_quarantined",
+        "writer_degraded",
+    }
     assert all(r.severity == "page" for r in builtin_rules())
 
 

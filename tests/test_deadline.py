@@ -1,0 +1,324 @@
+"""End-to-end deadlines: admission refusal, queue expiry, mid-search
+cancellation (CPU and supervised-child paths), router decrement.
+
+The deadline is a *remaining budget in seconds* riding the submit frame.
+Every test here asserts the three observable promises of cooperative
+cancellation: the client gets a definite ``DeadlineExceeded`` (never a
+fake verdict), the worker/lease is freed within deadline + grace, and
+``verifyd_jobs_cancelled_total{reason=...}`` counts the event.
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+from s2_verification_tpu.service import scheduler as sched_mod
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.overload import CancelToken
+from s2_verification_tpu.service.router import (
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history(base: int = 100) -> str:
+    h = H()
+    h.append_ok(1, [base + 1], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+    return _text(h)
+
+
+def _fingerprint(text: str) -> str:
+    return history_fingerprint(
+        prepare(list(ev.iter_history(text)), elide_trivial=True)
+    )
+
+
+def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        unbounded_close=False,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=str(tmp_path / "stats.jsonl"),
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _events(tmp_path) -> list[dict]:
+    with open(tmp_path / "stats.jsonl", encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _cancelled(daemon, reason: str) -> float:
+    return daemon.registry.get("verifyd_jobs_cancelled_total").value(
+        reason=reason
+    )
+
+
+def _sleepy_cpu_check(monkeypatch):
+    """A CPU stage that honestly consumes its budget and never decides —
+    the shape of a history the oracle cannot close quickly."""
+
+    def sleepy(hist, budget):
+        time.sleep(min(budget if budget is not None else 0.5, 2.0))
+        return CheckResult(outcome=CheckOutcome.UNKNOWN), "oracle"
+
+    monkeypatch.setattr(sched_mod, "_cpu_check", sleepy)
+
+
+# -- the token itself --------------------------------------------------------
+
+
+def test_cancel_token_deadline_and_first_reason_wins():
+    tok = CancelToken(time.monotonic() + 60.0)
+    assert tok.check() is None
+    assert 59.0 < tok.remaining() <= 60.0
+    assert tok.cancel("client_gone") is True
+    assert tok.cancel("shutdown") is False  # first reason sticks
+    assert tok.check() == "client_gone"
+
+    expired = CancelToken(time.monotonic() - 0.01)
+    assert expired.check() == "deadline"  # auto-cancels on the clock
+    assert expired.remaining() == 0.0
+
+    unbounded = CancelToken()
+    assert unbounded.check() is None and unbounded.remaining() is None
+
+
+# -- admission ---------------------------------------------------------------
+
+
+def test_deadline_already_expired_at_admission(tmp_path):
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path, timeout=30)
+        with pytest.raises(VerifydError) as ei:
+            client.submit(good_history(), no_viz=True, deadline_s=0.0)
+        assert ei.value.cls == "DeadlineExceeded"
+        assert ei.value.extra.get("reason") == "deadline"
+        shed = daemon.registry.get("verifyd_admission_shed_total")
+        assert shed.value(reason="deadline") == 1
+        # Shed before the journal/queue: nothing was admitted.
+        assert daemon.stats.snapshot()["completed"] == 0
+    events = _events(tmp_path)
+    assert [e for e in events if e["ev"] == "admission_shed"]
+
+
+# -- queue expiry (cancellation boundary #1) ---------------------------------
+
+
+def test_deadline_expires_in_queue_never_starts(tmp_path, monkeypatch):
+    _sleepy_cpu_check(monkeypatch)
+    cfg = _daemon_cfg(tmp_path, time_budget_s=0.8)
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path, timeout=30)
+        blocker_reply = {}
+
+        def blocker():
+            blocker_reply.update(
+                client.submit(good_history(100), client="slow", no_viz=True)
+            )
+
+        t = threading.Thread(target=blocker)
+        t.start()
+        time.sleep(0.2)  # the worker is now inside the sleepy search
+        with pytest.raises(VerifydError) as ei:
+            VerifydClient(cfg.socket_path, timeout=30).submit(
+                good_history(200), client="doomed", no_viz=True,
+                deadline_s=0.2,
+            )
+        t.join(timeout=10)
+        assert ei.value.cls == "DeadlineExceeded"
+        assert blocker_reply["outcome"] == "unknown"  # bystander unharmed
+        assert _cancelled(daemon, "deadline") == 1
+    events = _events(tmp_path)
+    cancels = [e for e in events if e["ev"] == "job_cancelled"]
+    assert len(cancels) == 1
+    c = cancels[0]
+    assert c["reason"] == "deadline" and c["started"] is False
+    assert c["queue_wait_s"] >= 0.2  # it sat out its whole budget queued
+    # Never started: no start event for the doomed client.
+    assert not [
+        e for e in events if e["ev"] == "start" and e["client"] == "doomed"
+    ]
+
+
+# -- mid-search expiry on the CPU path (boundary #2) -------------------------
+
+
+def test_deadline_expires_mid_cpu_search(tmp_path, monkeypatch):
+    _sleepy_cpu_check(monkeypatch)
+    cfg = _daemon_cfg(tmp_path, time_budget_s=30.0, deadline_grace_s=1.0)
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path, timeout=30)
+        t0 = time.monotonic()
+        with pytest.raises(VerifydError) as ei:
+            client.submit(good_history(), no_viz=True, deadline_s=0.4)
+        elapsed = time.monotonic() - t0
+        assert ei.value.cls == "DeadlineExceeded"
+        # The 30s CPU budget was clamped to the 0.4s remaining: the
+        # worker freed within deadline + grace (+ scheduling slack).
+        assert elapsed < 0.4 + 1.0 + 2.0
+        assert _cancelled(daemon, "deadline") == 1
+    cancels = [e for e in _events(tmp_path) if e["ev"] == "job_cancelled"]
+    assert len(cancels) == 1 and cancels[0]["started"] is True
+
+
+# -- mid-search expiry on the supervised-child path --------------------------
+
+
+@pytest.mark.slow
+def test_deadline_frees_supervised_child_and_lease(tmp_path, monkeypatch):
+    """The hard case: the job is inside a supervised escalation child (a
+    real subprocess) when the deadline passes.  The drive loop's cancel
+    poll must SIGTERM the child, release the device lease, and answer
+    DeadlineExceeded — all within deadline + grace.
+
+    The child is made genuinely intractable by a ``sitecustomize.py``
+    injected via PYTHONPATH that sleeps at interpreter startup — the
+    real-subprocess analogue of a search that cannot finish in time."""
+
+    def instant_unknown(hist, budget):
+        return CheckResult(outcome=CheckOutcome.UNKNOWN), "oracle"
+
+    monkeypatch.setattr(sched_mod, "_cpu_check", instant_unknown)
+
+    wedge = tmp_path / "wedge"
+    wedge.mkdir()
+    (wedge / "sitecustomize.py").write_text(
+        "import os, time\n"
+        "if os.environ.get('VERIFYD_TEST_WEDGE_CHILD') == '1':\n"
+        "    time.sleep(120)\n",
+        encoding="utf-8",
+    )
+    import os as _os
+
+    existing = _os.environ.get("PYTHONPATH", "")
+    monkeypatch.setenv(
+        "PYTHONPATH",
+        str(wedge) + ((_os.pathsep + existing) if existing else ""),
+    )
+    monkeypatch.setenv("VERIFYD_TEST_WEDGE_CHILD", "1")
+
+    text = good_history()
+    cfg = _daemon_cfg(
+        tmp_path,
+        device="supervised",
+        mesh_devices=1,
+        spool_dir=str(tmp_path / "spool"),
+        attempt_timeout_s=60.0,
+        time_budget_s=0.05,
+        deadline_grace_s=1.0,
+        state_dir=str(tmp_path / "state"),
+    )
+    with Verifyd(cfg) as daemon:
+        client = VerifydClient(cfg.socket_path, timeout=60)
+        t0 = time.monotonic()
+        with pytest.raises(VerifydError) as ei:
+            client.submit(text, no_viz=True, deadline_s=1.0)
+        elapsed = time.monotonic() - t0
+        assert ei.value.cls == "DeadlineExceeded"
+        # deadline (1.0) + grace (1.0) + spawn/kill slack.
+        assert elapsed < 1.0 + 1.0 + 4.0
+        # The lease went back to the pool the moment the child died.
+        assert daemon.device_pool.snapshot()["in_use"] == 0
+        assert _cancelled(daemon, "deadline") == 1
+        # Our own SIGTERM is not a crash: the poison ledger stays clean.
+        assert daemon.quarantine.crash_count(_fingerprint(text)) == 0
+    cancels = [e for e in _events(tmp_path) if e["ev"] == "job_cancelled"]
+    assert len(cancels) == 1
+    assert cancels[0]["reason"] == "deadline" and cancels[0]["started"] is True
+
+
+# -- router decrement across failover ----------------------------------------
+
+
+def _router_cfg(tmp_path, names) -> RouterConfig:
+    return RouterConfig(
+        listen=str(tmp_path / "router.sock"),
+        backends=tuple(
+            BackendSpec(n, str(tmp_path / f"{n}.sock")) for n in names
+        ),
+        probe_interval_s=30.0,
+        breaker_failures=5,
+        max_failovers=2,
+    )
+
+
+def test_router_decrements_deadline_across_failover(tmp_path):
+    """A failed attempt burns real wall clock; the next backend must see
+    a *smaller* remaining budget, not the client's original number."""
+    from s2_verification_tpu.service.client import VerifydUnavailable
+
+    router = VerifydRouter(_router_cfg(tmp_path, ("a", "b")))
+    calls = []
+
+    def dying(text, **kw):
+        calls.append(("dead", kw.get("deadline_s")))
+        time.sleep(0.25)  # the budget this attempt burned
+        raise VerifydUnavailable("Unavailable", "connect refused")
+
+    def answering(text, **kw):
+        calls.append(("live", kw.get("deadline_s")))
+        return {"verdict": 0, "outcome": "ok", "cached": False}
+
+    # Whichever node the ring prefers dies first; the other answers.
+    order = router._candidate_order(_fingerprint(good_history()))[0]
+    order[0].client.submit = dying
+    order[1].client.submit = answering
+
+    reply = router._route_submit(
+        {"op": "submit", "history": good_history(), "deadline": 2.0}
+    )
+    assert reply["ok"]["verdict"] == 0 and reply["ok"]["node"] == order[1].name
+    assert [kind for kind, _ in calls] == ["dead", "live"]
+    first, second = calls[0][1], calls[1][1]
+    assert first is not None and first <= 2.0
+    # The second attempt's budget is short the ~0.25s the first burned.
+    assert second <= first - 0.2
+
+
+def test_router_refuses_third_node_when_deadline_spent(tmp_path):
+    from s2_verification_tpu.service.client import VerifydUnavailable
+
+    router = VerifydRouter(_router_cfg(tmp_path, ("a", "b")))
+
+    def dying(text, **kw):
+        time.sleep(0.3)
+        raise VerifydUnavailable("Unavailable", "connect refused")
+
+    untouched = []
+    order = router._candidate_order(_fingerprint(good_history()))[0]
+    order[0].client.submit = dying
+    order[1].client.submit = lambda *a, **kw: untouched.append(1)
+
+    reply = router._route_submit(
+        {"op": "submit", "history": good_history(), "deadline": 0.2}
+    )
+    e = reply["err"]
+    assert e["class"] == "DeadlineExceeded" and e["reason"] == "deadline"
+    assert e["attempts"] == 1
+    assert untouched == []  # no stale-clock handoff to a third node

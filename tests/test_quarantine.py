@@ -1,0 +1,237 @@
+"""Poison-job quarantine: the per-fingerprint crash ledger across boots.
+
+The scenario the subsystem exists for: a history whose verification
+reliably kills the daemon (or its escalation child).  Without the
+ledger, journal recovery faithfully replays the killer on every boot —
+a crash loop.  With it, the fingerprint that was *running* at each
+death accumulates crash counts across restarts and lands in quarantine
+at the threshold, while innocent jobs that merely sat in the same
+journal replay for free.
+"""
+
+import contextlib
+import io
+import json
+import time
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.journal import JobJournal
+from s2_verification_tpu.service.overload import QuarantineStore
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def good_history(base: int = 100) -> str:
+    h = H()
+    h.append_ok(1, [base + 1], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
+    return _text(h)
+
+
+def _fingerprint(text: str) -> str:
+    return history_fingerprint(
+        prepare(list(ev.iter_history(text)), elide_trivial=True)
+    )
+
+
+def _cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        no_viz=True,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=str(tmp_path / "stats.jsonl"),
+        state_dir=str(tmp_path / "state"),
+        quarantine_threshold=3,
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _events(tmp_path) -> list[dict]:
+    with open(tmp_path / "stats.jsonl", encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _crash(daemon: Verifyd) -> None:
+    """Tear a constructed-but-never-entered daemon down the way SIGKILL
+    would leave it: durable files closed mid-promise, no done records,
+    no graceful drain."""
+    daemon.journal.close()
+    daemon.cache.close()
+    if daemon.flight is not None:
+        daemon.flight.close()
+    if daemon.archive is not None:
+        daemon.archive.close()
+    if daemon._stats_file is not None:
+        with contextlib.suppress(OSError):
+            daemon._stats_file.close()
+
+
+# -- the store itself --------------------------------------------------------
+
+
+def test_store_counts_persist_and_release(tmp_path):
+    s = QuarantineStore(str(tmp_path / "q"), threshold=2)
+    fp = "ab" * 32
+    assert s.note_crash(fp) == 1
+    assert not s.is_quarantined(fp)
+
+    again = QuarantineStore(str(tmp_path / "q"), threshold=2)  # "reboot"
+    assert again.crash_count(fp) == 1  # the ledger survived
+    assert again.note_crash(fp) == 2
+    assert again.is_quarantined(fp)
+    entry = again.get(fp)
+    assert entry["fingerprint"] == fp and entry["crashes"] == 2
+
+    assert again.release(fp) is True
+    assert not again.is_quarantined(fp)
+    assert again.release(fp) is False  # idempotent: nothing held
+
+    # A conclusive verdict forgives accumulated warm counts.
+    s2 = QuarantineStore(str(tmp_path / "q2"), threshold=3)
+    s2.note_crash(fp)
+    s2.note_crash(fp)
+    s2.note_success(fp)
+    assert s2.crash_count(fp) == 0
+
+
+# -- the crash-loop scenario across boots ------------------------------------
+
+
+def test_poison_quarantined_within_three_boots_innocent_replays(tmp_path):
+    """A fingerprint in flight at three successive daemon deaths is
+    quarantined; an unrelated orphan sharing the journal still replays
+    and completes; release re-admits the poison fingerprint."""
+    poison_text = good_history(1000)
+    innocent_text = good_history(2000)
+    poison_fp = _fingerprint(poison_text)
+    innocent_fp = _fingerprint(innocent_text)
+    cfg = _cfg(tmp_path)
+
+    # Boot 1 dies mid-job: write the journal the way a killed daemon
+    # leaves it — poison accepted AND started, innocent only accepted.
+    journal = JobJournal(str(tmp_path / "state" / "journal"))
+    journal.accept(
+        job=1, fingerprint=poison_fp, client="poison", priority=10,
+        history=poison_text,
+    )
+    journal.started(job=1, fingerprint=poison_fp)
+    journal.accept(
+        job=2, fingerprint=innocent_fp, client="innocent", priority=10,
+        history=innocent_text,
+    )
+    journal.close()
+
+    # Boots 2 and 3: recovery re-admits both orphans and charges the
+    # started one a crash; a worker picks the poison job up (run record)
+    # and the daemon dies again before it can finish.
+    for boot, expected_crashes in ((2, 1), (3, 2)):
+        d = Verifyd(cfg)
+        d._recover_orphans()
+        assert d.quarantine.crash_count(poison_fp) == expected_crashes
+        assert not d.quarantine.is_quarantined(poison_fp)
+        # Both orphans were re-admitted — the innocent one is not
+        # filtered, it simply never gets a run record.
+        batch = d.queue.get_batch(batch_max=16, timeout=1.0)
+        batch += d.queue.get_batch(batch_max=16, timeout=0.1)
+        by_fp = {j.fingerprint: j for j in batch}
+        assert set(by_fp) == {poison_fp, innocent_fp}, f"boot {boot}"
+        d.journal.started(
+            job=by_fp[poison_fp].id, fingerprint=poison_fp
+        )
+        _crash(d)
+
+    # Boot 4: the third charged crash crosses the threshold.  The poison
+    # fingerprint is quarantined instead of replayed; the innocent
+    # orphan replays through a live worker and completes.
+    with Verifyd(cfg) as d:
+        assert d.quarantine.is_quarantined(poison_fp)
+        assert d.quarantine.crash_count(poison_fp) == 3
+        client = VerifydClient(cfg.socket_path, timeout=60)
+
+        # The innocent orphan's verdict lands in the durable cache; the
+        # original submitter's retry answers warm.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if d.stats.snapshot()["completed"] >= 1:
+                break
+            time.sleep(0.05)
+        reply = client.submit(innocent_text, client="retry")
+        assert reply["verdict"] == 0 and reply["cached"] is True
+
+        # A fresh submit of the poison history is refused outright —
+        # definite, so a router never fails it over to poison a peer.
+        with pytest.raises(VerifydError) as ei:
+            client.submit(poison_text, client="retry")
+        assert ei.value.cls == "Quarantined"
+        assert ei.value.extra.get("fingerprint") == poison_fp
+        assert ei.value.extra.get("crashes") == 3
+
+        # Operator loop: list -> release -> the job completes normally.
+        listing = client.quarantine("list")
+        assert listing["threshold"] == 3
+        assert [e["fingerprint"] for e in listing["entries"]] == [poison_fp]
+        inspect = client.quarantine("inspect", poison_fp)
+        assert inspect["crashes"] == 3
+        released = client.quarantine("release", poison_fp)
+        assert released["released"] is True
+        reply = client.submit(poison_text, client="retry")
+        assert reply["verdict"] == 0
+        # The conclusive verdict forgave the ledger entry for good.
+        assert d.quarantine.crash_count(poison_fp) == 0
+        assert d.registry.get("verifyd_quarantine_size").value() == 0
+
+    events = _events(tmp_path)
+    quarantined = [e for e in events if e["ev"] == "job_quarantined"]
+    assert len(quarantined) == 1
+    assert quarantined[0]["fingerprint"] == poison_fp
+    assert quarantined[0]["crashes"] == 3
+    skipped = [e for e in events if e["ev"] == "orphan_quarantined"]
+    assert len(skipped) == 1 and skipped[0]["fingerprint"] == poison_fp
+    # The alert engine's builtin rules page on the quarantine event.
+    from s2_verification_tpu.obs.alerts import builtin_rules
+
+    assert any(r.event == "job_quarantined" for r in builtin_rules())
+
+
+def test_queued_only_orphan_is_never_charged(tmp_path):
+    """An orphan with no run record — the daemon died before any worker
+    touched it — accrues no crash count no matter how many boots it
+    survives in the journal."""
+    text = good_history(3000)
+    fp = _fingerprint(text)
+    cfg = _cfg(tmp_path, workers=1)
+
+    journal = JobJournal(str(tmp_path / "state" / "journal"))
+    journal.accept(
+        job=1, fingerprint=fp, client="queued", priority=10, history=text
+    )
+    journal.close()
+
+    for _ in range(4):  # well past the threshold of 3
+        d = Verifyd(cfg)
+        d._recover_orphans()
+        assert d.quarantine.crash_count(fp) == 0
+        _crash(d)
+
+    with Verifyd(cfg) as d:
+        assert not d.quarantine.is_quarantined(fp)
+        client = VerifydClient(cfg.socket_path, timeout=60)
+        assert client.submit(text, client="retry")["verdict"] == 0
