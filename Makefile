@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload perfgate clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak perfgate clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: obs mesh fleet overload
+chaos-full: obs mesh fleet overload soak
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -69,6 +69,15 @@ mesh:
 # AdmissionController within 3% of a disarmed service_bench run.
 overload:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/overload_check.py
+
+# Closed-loop soak gate (scripts/soak_check.py): the full seeded
+# campaign matrix (every violation class once) through a router +
+# 2-daemon fleet with one backend SIGKILLed and restarted mid-soak —
+# every ground-truth label must match its verdict with zero lost jobs,
+# and a deliberately mislabeled control must fire the
+# checker_false_verdict alert, dump a flight marker, and exit nonzero.
+soak:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_check.py
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

@@ -23,6 +23,15 @@
               its verdict (75 = queue full after retries, 69 = no daemon
               ever answered, 76 = a daemon was reached but refused after
               retries — bad secret, persistent frame errors).
+``soak``    — the closed verification loop: generate ground-truth-labeled
+              histories from seeded fault campaigns (``collect
+              --list-campaigns``), submit each to a live daemon or router
+              fleet, and score every verdict against its label.  Any
+              contradiction raises the ``checker_false_verdict`` builtin
+              alert, dumps a flight-recorder marker (fingerprint +
+              campaign seed = one-command repro), and exits 1; a loop that
+              could not prove itself clean (lost submissions, UNKNOWN
+              verdicts, unconfirmed injections) exits 3.
 ``profiles``— query the durable per-job profile archive: live against a
               running daemon (``--socket``) or cold from a dead daemon's
               ``--state-dir``; filter by shape/backend/client/verdict/
@@ -404,11 +413,61 @@ def _check_one(args: argparse.Namespace, file_path: str) -> int:
 
 
 def _cmd_collect(args: argparse.Namespace) -> int:
+    if args.list_campaigns:
+        from .collector.campaign import builtin_campaigns
+
+        for name, c in sorted(builtin_campaigns().items()):
+            print(
+                f"{name:16s} workflow={c.workflow:13s} "
+                f"violation={c.violation_class() or '-':15s} {c.description}"
+            )
+        return 0
+    if args.campaign:
+        from .collector.campaign import collect_labeled_to_file, get_campaign
+
+        try:
+            campaign = get_campaign(args.campaign)
+        except KeyError as e:
+            log.error("%s", e.args[0])
+            return USAGE_EXIT
+        if args.socket:
+            log.error(
+                "--campaign needs the in-process path (per-client fault "
+                "facades); --socket is unsupported"
+            )
+            return USAGE_EXIT
+        if args.workflow is not None and args.workflow != campaign.workflow:
+            log.warning(
+                "--workflow %s ignored: campaign %r runs workflow %s",
+                args.workflow,
+                campaign.name,
+                campaign.workflow,
+            )
+        path, lpath, label = collect_labeled_to_file(
+            campaign,
+            args.seed,
+            out_dir=args.out_dir,
+            clients=args.num_concurrent_clients,
+            ops=args.num_ops_per_client,
+        )
+        log.info(
+            "ground-truth label expect=%s (violation=%s confirmed=%s) at %s",
+            label["expect"],
+            label["violation"],
+            label["confirmed"],
+            lpath,
+        )
+        print(path)
+        return 0
     faults = FaultPlan.chaos(args.chaos) if args.chaos > 0 else FaultPlan()
     cfg = CollectConfig(
-        num_concurrent_clients=args.num_concurrent_clients,
-        num_ops_per_client=args.num_ops_per_client,
-        workflow=args.workflow,
+        num_concurrent_clients=(
+            5 if args.num_concurrent_clients is None else args.num_concurrent_clients
+        ),
+        num_ops_per_client=(
+            100 if args.num_ops_per_client is None else args.num_ops_per_client
+        ),
+        workflow=args.workflow if args.workflow is not None else "regular",
         seed=args.seed,
         faults=faults,
     )
@@ -1290,6 +1349,95 @@ def _cmd_submit(args: argparse.Namespace) -> int:
     return verdict if verdict in (0, 1, 2) else USAGE_EXIT
 
 
+def _cmd_soak(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from .collector.campaign import get_campaign
+    from .service.soak import SoakConfig, SoakRunner, soak_exit_code
+
+    for name in args.campaign or ():
+        try:
+            get_campaign(name)
+        except KeyError as e:
+            log.error("%s", e.args[0])
+            return USAGE_EXIT
+    try:
+        secret = _read_secret(args)
+    except OSError as e:
+        log.error("failed to read secret: %s", e)
+        return USAGE_EXIT
+    cfg = SoakConfig(
+        address=args.socket,
+        secret=secret,
+        campaigns=tuple(args.campaign or ()),
+        seed=args.seed,
+        cycles=args.cycles,
+        clients=args.num_concurrent_clients,
+        ops=args.num_ops_per_client,
+        retries=args.retries,
+        backoff_s=args.backoff,
+        submit_timeout_s=args.timeout,
+        deadline_s=args.deadline,
+        alert_url=args.alert_url,
+        state_dir=args.state_dir,
+        mislabel_first=args.mislabel_control,
+    )
+    runner = SoakRunner(cfg)
+    server = None
+    if args.metrics_port is not None:
+        from .obs.httpd import MetricsServer
+
+        server = MetricsServer(runner.registry, args.metrics_port)
+        log.info("soak metrics at %s", server.url)
+    try:
+        summary = runner.run()
+    finally:
+        if server is not None:
+            server.close()
+    code = soak_exit_code(summary)
+    if args.json:
+        print(_json.dumps(summary, sort_keys=True), flush=True)
+    else:
+        line = {
+            "generated": summary["generated"],
+            "submitted": summary["submitted"],
+            "ok": summary["ok"],
+            "false_verdicts": len(summary["false_verdicts"]),
+            "submit_errors": len(summary["submit_errors"]),
+            "inconclusive": summary["inconclusive"],
+            "unlabeled": summary["unlabeled"],
+            "verdict_table": summary["verdict_table"],
+            "wall_s": summary["wall_s"],
+        }
+        print(_json.dumps(line, sort_keys=True), flush=True)
+    if code == 0:
+        log.info(
+            "soak clean: %d/%d verdicts matched ground truth",
+            summary["ok"],
+            summary["submitted"],
+        )
+    elif code == 1:
+        for fv in summary["false_verdicts"]:
+            log.error(
+                "false verdict: campaign=%s seed=%d expected=%s actual=%s "
+                "fingerprint=%s",
+                fv["campaign"],
+                fv["seed"],
+                fv["expect"],
+                fv["actual"],
+                fv.get("fingerprint"),
+            )
+    else:
+        log.error(
+            "soak inconclusive: %d submit errors, %d UNKNOWN verdicts, "
+            "%d unlabeled skips",
+            len(summary["submit_errors"]),
+            summary["inconclusive"],
+            summary["unlabeled"],
+        )
+    return code
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = _Parser(
         prog="s2-verification-tpu",
@@ -1375,12 +1523,23 @@ def build_parser() -> argparse.ArgumentParser:
         default="stream",
         help="ignored (collection runs against the in-process fake S2)",
     )
-    g.add_argument("--num-concurrent-clients", type=int, default=5)
-    g.add_argument("--num-ops-per-client", type=int, default=100)
+    g.add_argument(
+        "--num-concurrent-clients",
+        type=int,
+        default=None,
+        help="default 5 (or the campaign's own sizing with --campaign)",
+    )
+    g.add_argument(
+        "--num-ops-per-client",
+        type=int,
+        default=None,
+        help="default 100 (or the campaign's own sizing with --campaign)",
+    )
     g.add_argument(
         "--workflow",
-        default="regular",
+        default=None,
         choices=["regular", "match-seq-num", "fencing"],
+        help="default regular; a --campaign dictates its own workflow",
     )
     g.add_argument("--seed", type=int, default=0)
     g.add_argument(
@@ -1388,6 +1547,19 @@ def build_parser() -> argparse.ArgumentParser:
         type=float,
         default=0.2,
         help="fault-injection intensity for the fake S2 (0 disables)",
+    )
+    g.add_argument(
+        "--campaign",
+        metavar="NAME",
+        help="run one named fault campaign (time-phased faults, optional "
+        "deliberate violation) and write a ground-truth "
+        "<path>.label.json sidecar (expect=legal|illegal + the injected "
+        "violation class) next to the history",
+    )
+    g.add_argument(
+        "--list-campaigns",
+        action="store_true",
+        help="list the builtin campaign matrix and exit",
     )
     g.add_argument("--out-dir", default="./data")
     g.add_argument(
@@ -2129,6 +2301,95 @@ def build_parser() -> argparse.ArgumentParser:
         "queue wait, cache hit) on stdout",
     )
     u.set_defaults(fn=_cmd_submit)
+
+    k = sub.add_parser(
+        "soak",
+        help="closed-loop soak: generate labeled fault-campaign histories, "
+        "submit them to a live daemon/fleet, and score every verdict "
+        "against its ground-truth label",
+    )
+    k.add_argument(
+        "socket",
+        help="daemon or router address (unix-socket path, or HOST:PORT "
+        "with --secret-file / VERIFYD_SECRET)",
+    )
+    k.add_argument(
+        "--campaign",
+        action="append",
+        metavar="NAME",
+        help="campaign to run (repeatable; default: the full builtin "
+        "matrix — see `collect --list-campaigns`)",
+    )
+    k.add_argument("--seed", type=int, default=0, help="schedule seed base")
+    k.add_argument(
+        "--cycles",
+        type=int,
+        default=1,
+        help="passes over the campaign list, each with fresh derived seeds",
+    )
+    k.add_argument(
+        "--num-concurrent-clients",
+        type=int,
+        default=None,
+        help="override each campaign's client sizing",
+    )
+    k.add_argument(
+        "--num-ops-per-client",
+        type=int,
+        default=None,
+        help="override each campaign's per-client op count",
+    )
+    k.add_argument(
+        "--retries",
+        type=int,
+        default=8,
+        help="per-history re-submissions riding out fleet failovers "
+        "(default 8)",
+    )
+    k.add_argument("--backoff", type=float, default=0.25, metavar="SECONDS")
+    k.add_argument(
+        "--timeout",
+        type=float,
+        default=120.0,
+        help="per-attempt verdict wait (default 120s)",
+    )
+    k.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="total wall-clock budget per submission across retries",
+    )
+    k.add_argument(
+        "--alert-url",
+        default=None,
+        help="webhook for checker_false_verdict alert delivery",
+    )
+    k.add_argument(
+        "--state-dir",
+        default=None,
+        help="flight-recorder ring + offending-history dumps land here",
+    )
+    k.add_argument(
+        "--metrics-port",
+        type=int,
+        default=None,
+        help="serve verifyd_soak_* families on /metrics (0 = ephemeral port)",
+    )
+    k.add_argument(
+        "--mislabel-control",
+        action="store_true",
+        help="deliberately flip the first history's label — a control case "
+        "proving the checker_false_verdict alert + nonzero exit fire",
+    )
+    k.add_argument("--secret-file", default=None)
+    k.add_argument(
+        "--json",
+        action="store_true",
+        help="print the full machine-readable summary (default: one "
+        "compact summary line)",
+    )
+    k.set_defaults(fn=_cmd_soak)
     return p
 
 

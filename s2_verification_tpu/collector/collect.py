@@ -62,8 +62,21 @@ def initialize_tail(sink: HistorySink, op_id: int, tail: int, hashes: list[int])
     sink.send(ev.LabeledEvent(ev.AppendSuccess(tail=tail), client_id=0, op_id=op_id))
 
 
-async def _run(cfg: CollectConfig, stream: S2StreamTransport) -> list[ev.LabeledEvent]:
-    sink = HistorySink()
+def _make_sink(stream: S2StreamTransport, writer=None) -> HistorySink:
+    """Campaign streams expose an ``observe`` hook (violation confirmation
+    rides on log order); plain streams don't — wire it when present."""
+    return HistorySink(writer=writer, observer=getattr(stream, "observe", None))
+
+
+def _client_stream(stream: S2StreamTransport, slot: int) -> S2StreamTransport:
+    """Per-client view of the stream.  Campaign streams hand each spawned
+    client a slot-tagged facade (partitions and violations are per-client);
+    plain streams are shared as-is."""
+    for_client = getattr(stream, "for_client", None)
+    return for_client(slot) if for_client is not None else stream
+
+
+async def _run(cfg: CollectConfig, stream: S2StreamTransport, sink: HistorySink) -> None:
     ids = Ids()
 
     # Deterministic virtual time: client tasks only yield at sleep points,
@@ -100,7 +113,7 @@ async def _run(cfg: CollectConfig, stream: S2StreamTransport) -> list[ev.Labeled
     async def client(i: int) -> list[ev.LabeledEvent]:
         try:
             return await run_client(
-                stream,
+                _client_stream(stream, i),
                 sink,
                 ids,
                 random.Random((cfg.seed << 16) ^ (i + 1)),
@@ -122,14 +135,13 @@ async def _run(cfg: CollectConfig, stream: S2StreamTransport) -> list[ev.Labeled
     log.debug(
         "all clients done: %d events collected, flushing %d deferred "
         "indefinite-failure finishes",
-        len(sink.events),
+        sink.count,
         n_deferred,
     )
     for deferred in deferred_lists:
         for le in deferred:
             assert isinstance(le.event, ev.AppendIndefiniteFailure)
             sink.send(le)
-    return sink.events
 
 
 def default_stream(cfg: CollectConfig) -> FakeS2Stream:
@@ -149,7 +161,9 @@ def collect_history(
     """Collect a history in-memory; returns the full event list."""
     if stream is None:
         stream = default_stream(cfg)
-    return asyncio.run(_run(cfg, stream))
+    sink = _make_sink(stream)
+    asyncio.run(_run(cfg, stream, sink))
+    return sink.events
 
 
 def collect_to_file(
@@ -157,8 +171,14 @@ def collect_to_file(
     stream: S2StreamTransport | None = None,
     out_dir: str = "./data",
 ) -> str:
-    """Collect and write ``<out_dir>/records.<epoch>.jsonl``; returns the path."""
-    events = collect_history(cfg, stream)
+    """Collect, streaming straight into ``<out_dir>/records.<epoch>.jsonl``;
+    returns the path.
+
+    Events hit the file the moment they are recorded (the sink writes
+    through), so an arbitrarily long soak collection holds O(window)
+    memory, not O(history)."""
+    if stream is None:
+        stream = default_stream(cfg)
     os.makedirs(out_dir, exist_ok=True)
     epoch = int(time.time())
     path = os.path.join(out_dir, f"records.{epoch}.jsonl")
@@ -167,9 +187,19 @@ def collect_to_file(
         try:
             # Exclusive create: two collections in the same second must not
             # concatenate into one corrupt history.
-            with open(path, "x", encoding="utf-8") as f:
-                ev.write_history(events, f)
-            return path
+            f = open(path, "x", encoding="utf-8")
+            break
         except FileExistsError:
             suffix += 1
             path = os.path.join(out_dir, f"records.{epoch}.{suffix}.jsonl")
+    try:
+        with f:
+            asyncio.run(_run(cfg, stream, _make_sink(stream, writer=f)))
+    except BaseException:
+        # Never leave a truncated history behind masquerading as complete.
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        raise
+    return path

@@ -85,13 +85,36 @@ class Ids:
 
 
 class HistorySink:
-    """Single-writer event log (the reference's mpsc writer task)."""
+    """Single-writer event log (the reference's mpsc writer task).
 
-    def __init__(self) -> None:
+    Without a ``writer`` every event is buffered in ``self.events`` (the
+    in-memory path).  With a ``writer`` (any ``.write(str)`` text sink)
+    each event is encoded and written the moment it is sent — the process
+    holds O(window) state instead of O(history), which is what lets a soak
+    run collect unbounded histories.  The encode path is shared with
+    :func:`~..utils.events.write_history`, so the streamed bytes are
+    identical to a buffered collect-then-write.
+
+    ``observer`` (if given) sees every event in final log order on either
+    path; campaign streams use it to watch for violation confirmation
+    without retaining the history.
+    """
+
+    def __init__(self, writer=None, observer=None) -> None:
         self.events: list[ev.LabeledEvent] = []
+        self.count = 0
+        self._writer = writer
+        self._observer = observer
 
     def send(self, le: ev.LabeledEvent) -> None:
-        self.events.append(le)
+        self.count += 1
+        if self._observer is not None:
+            self._observer(le)
+        if self._writer is not None:
+            self._writer.write(ev.encode_event(le))
+            self._writer.write("\n")
+        else:
+            self.events.append(le)
 
 
 def generate_records(rng: random.Random, num_records: int) -> tuple[list[bytes], list[int]]:

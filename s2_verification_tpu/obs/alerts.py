@@ -143,14 +143,20 @@ def parse_rule(spec: str) -> AlertRule:
 
 def builtin_rules() -> Tuple[AlertRule, ...]:
     """The signals every deployment should page on: SLO burn, perf
-    regressions, retrace storms, a poison job entering quarantine, and a
-    durable writer degrading (journal on a full disk)."""
+    regressions, retrace storms, a poison job entering quarantine, a
+    durable writer degrading (journal on a full disk), and the soak loop
+    catching the checker contradicting a ground-truth label."""
     return (
         AlertRule(name="slo_breach", kind="event", event="slo_breach"),
         AlertRule(name="perf_regression", kind="event", event="perf_regression"),
         AlertRule(name="retrace_storm", kind="event", event="retrace_storm"),
         AlertRule(name="job_quarantined", kind="event", event="job_quarantined"),
         AlertRule(name="writer_degraded", kind="event", event="writer_degraded"),
+        AlertRule(
+            name="checker_false_verdict",
+            kind="event",
+            event="checker_false_verdict",
+        ),
     )
 
 

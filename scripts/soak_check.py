@@ -1,0 +1,368 @@
+"""Soak gate: the closed verification loop proven against a faulted fleet.
+
+Topology under test: 2 verifyd backends (separate processes, durable
+``--state-dir``, authenticated TCP) behind one in-process
+``VerifydRouter``, fronted by the soak runner.
+
+Phases, all against campaign ground-truth labels:
+
+1. **Seeded matrix, SIGKILL mid-soak** — the full builtin campaign
+   matrix (every violation class once, every legal fault shape once)
+   runs through the router while a watcher SIGKILLs one backend after a
+   few verdicts and restarts it on the same state dir.  Assertions:
+   zero lost accepted jobs (no submit errors after retries), every
+   ``expect=illegal`` history verdicts ILLEGAL, every ``expect=legal``
+   history verdicts LEGAL, nothing unlabeled or inconclusive — soak
+   exit code 0.
+2. **Mislabeled control** — the ``soak`` CLI runs one campaign with
+   ``--mislabel-control``, deliberately flipping the ground-truth label.
+   Assertions: exit code 1, a ``checker_false_verdict`` webhook is
+   delivered to the alert sink, and the flight ring holds a
+   ``checker_false_verdict`` dump marker carrying the fingerprint +
+   campaign seed repro command.
+
+Exit 0 when every assertion holds; 1 with failures on stderr.  One JSON
+summary line lands on stdout.  ``make soak`` runs this; ``make
+chaos-full`` includes it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import http.server
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from s2_verification_tpu.cli import main as cli_main  # noqa: E402
+from s2_verification_tpu.obs.flight import read_flight  # noqa: E402
+from s2_verification_tpu.service.client import (  # noqa: E402
+    VerifydClient,
+    VerifydError,
+)
+from s2_verification_tpu.service.router import (  # noqa: E402
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.service.soak import (  # noqa: E402
+    SoakConfig,
+    SoakRunner,
+    soak_exit_code,
+)
+
+SECRET = b"soak-check-shared-secret"
+SEED = 13
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_backend(
+    name: str, tmp: str, tcp_port: int, metrics_port: int
+) -> subprocess.Popen:
+    sock = os.path.join(tmp, f"{name}.sock")
+    if os.path.exists(sock):
+        os.remove(sock)  # SIGKILL leaves the socket file; serve refuses it
+    secret_file = os.path.join(tmp, "secret")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "s2_verification_tpu",
+            "serve",
+            "-socket",
+            sock,
+            "--workers",
+            "1",
+            "--device",
+            "off",
+            "-no-viz",
+            "--tcp",
+            f"127.0.0.1:{tcp_port}",
+            "--secret-file",
+            secret_file,
+            "--state-dir",
+            os.path.join(tmp, f"state-{name}"),
+            "--metrics-port",
+            str(metrics_port),
+            "--drain-timeout",
+            "15",
+            "--stats-log",
+            "",
+            "-out-dir",
+            os.path.join(tmp, "viz"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    probe = VerifydClient(f"127.0.0.1:{tcp_port}", secret=SECRET)
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"backend {name} exited rc={proc.returncode} before binding"
+            )
+        try:
+            probe.ping(timeout=1.0)
+            return proc
+        except (VerifydError, OSError):
+            pass
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"backend {name} never answered ping")
+        time.sleep(0.1)
+
+
+class _AlertSink(http.server.ThreadingHTTPServer):
+    """Collects alertmanager-v1 webhook posts (a JSON list of alerts)."""
+
+    def __init__(self) -> None:
+        self.received: list[dict] = []
+        sink = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self) -> None:  # noqa: N802 (http.server API)
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(n))
+                except ValueError:
+                    payload = []
+                for alert in payload if isinstance(payload, list) else []:
+                    sink.received.append(alert)
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *_a) -> None:
+                pass
+
+        super().__init__(("127.0.0.1", 0), _Handler)
+        self.daemon_threads = True
+        self._thread = threading.Thread(target=self.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self.server_address[1]}/alerts"
+
+    def alertnames(self) -> list[str]:
+        return [a.get("labels", {}).get("alertname") for a in self.received]
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="soak-check-")
+    failures: list[str] = []
+    summary: dict = {}
+    procs: dict[str, subprocess.Popen] = {}
+    t0 = time.monotonic()
+    sink = _AlertSink()
+    try:
+        with open(os.path.join(tmp, "secret"), "wb") as f:
+            f.write(SECRET)
+        ports = {n: _free_port() for n in ("a", "b")}
+        mports = {n: _free_port() for n in ("a", "b")}
+        for n in ("a", "b"):
+            procs[n] = _spawn_backend(n, tmp, ports[n], mports[n])
+        print(
+            f"# backends up: a=127.0.0.1:{ports['a']} b=127.0.0.1:{ports['b']}",
+            file=sys.stderr,
+        )
+
+        listen = os.path.join(tmp, "router.sock")
+        rcfg = RouterConfig(
+            listen=listen,
+            backends=tuple(
+                BackendSpec(
+                    n,
+                    f"127.0.0.1:{ports[n]}",
+                    f"http://127.0.0.1:{mports[n]}/healthz",
+                )
+                for n in ("a", "b")
+            ),
+            secret=SECRET,
+            probe_interval_s=0.3,
+            breaker_failures=2,
+            breaker_reset_s=1.0,
+        )
+        with VerifydRouter(rcfg):
+            # Phase 1: the full matrix with a SIGKILL + restart mid-soak.
+            scfg = SoakConfig(
+                address=listen,
+                seed=SEED,
+                retries=10,
+                backoff_s=0.2,
+                alert_url=sink.url,
+                state_dir=os.path.join(tmp, "soak-state"),
+            )
+            runner = SoakRunner(scfg)
+            n_campaigns = len(runner.schedule())
+            victim = "a"
+            kill_state = {"killed_at": None, "restarted": False}
+
+            def _killer() -> None:
+                # Genuinely mid-soak: strike once a third of the schedule
+                # has been scored, then rejoin on the same state dir.
+                while runner._m_phase.value() < max(2, n_campaigns // 3):
+                    time.sleep(0.02)
+                os.kill(procs[victim].pid, signal.SIGKILL)
+                procs[victim].wait()
+                kill_state["killed_at"] = runner._m_phase.value()
+                print(
+                    f"# SIGKILL backend {victim} at schedule position "
+                    f"{kill_state['killed_at']:.0f}/{n_campaigns}",
+                    file=sys.stderr,
+                )
+                procs[victim] = _spawn_backend(
+                    victim, tmp, ports[victim], mports[victim]
+                )
+                kill_state["restarted"] = True
+
+            killer = threading.Thread(target=_killer, daemon=True)
+            killer.start()
+            matrix = runner.run()
+            killer.join(timeout=120)
+
+            code = soak_exit_code(matrix)
+            if code != 0:
+                failures.append(f"matrix: soak exit {code}, want 0")
+            if kill_state["killed_at"] is None:
+                failures.append("matrix: the SIGKILL never happened")
+            elif kill_state["killed_at"] >= n_campaigns:
+                failures.append("matrix: the SIGKILL landed after the soak")
+            if not kill_state["restarted"]:
+                failures.append(f"matrix: backend {victim} never restarted")
+            if matrix["submit_errors"]:
+                failures.append(
+                    f"matrix: {len(matrix['submit_errors'])} submissions lost "
+                    f"across the kill window: {matrix['submit_errors']}"
+                )
+            for row in matrix["results"]:
+                if row["outcome"] not in ("ok",):
+                    failures.append(
+                        f"matrix: {row['campaign']} seed={row['seed']} "
+                        f"expect={row['expect']} -> {row['outcome']} "
+                        f"(actual={row.get('actual')})"
+                    )
+            table = matrix["verdict_table"]
+            if table.get("illegal->illegal", 0) != 4:
+                failures.append(
+                    f"matrix: want all 4 violation classes ILLEGAL, got "
+                    f"{table}"
+                )
+            if table.get("legal->legal", 0) != n_campaigns - 4:
+                failures.append(
+                    f"matrix: want {n_campaigns - 4} legal campaigns LEGAL, "
+                    f"got {table}"
+                )
+            if "checker_false_verdict" in sink.alertnames():
+                failures.append("matrix: spurious false-verdict alert")
+            summary["matrix"] = {
+                "campaigns": n_campaigns,
+                "verdict_table": table,
+                "killed_at": kill_state["killed_at"],
+                "wall_s": matrix["wall_s"],
+            }
+            print(
+                f"# matrix clean: {matrix['ok']}/{matrix['submitted']} matched "
+                f"ground truth across the kill window ({table})",
+                file=sys.stderr,
+            )
+
+            # Phase 2: mislabeled control — the sentinel must fire and the
+            # soak CLI must exit 1.
+            control_state = os.path.join(tmp, "control-state")
+            rc = cli_main(
+                [
+                    "soak",
+                    listen,
+                    "--campaign",
+                    "steady",
+                    "--seed",
+                    str(SEED),
+                    "--mislabel-control",
+                    "--alert-url",
+                    sink.url,
+                    "--state-dir",
+                    control_state,
+                    "--retries",
+                    "10",
+                ]
+            )
+            if rc != 1:
+                failures.append(f"control: soak CLI exit {rc}, want 1")
+            deadline = time.monotonic() + 15
+            while (
+                "checker_false_verdict" not in sink.alertnames()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.1)
+            if "checker_false_verdict" not in sink.alertnames():
+                failures.append(
+                    f"control: no checker_false_verdict webhook delivered "
+                    f"(got {sink.alertnames()})"
+                )
+            marks = [
+                m
+                for m in read_flight(control_state)
+                if m.get("k") == "dump"
+                and m.get("reason") == "checker_false_verdict"
+            ]
+            if not marks:
+                failures.append("control: no checker_false_verdict flight marker")
+            elif not marks[0].get("repro") or "steady" not in marks[0]["repro"]:
+                failures.append(
+                    f"control: flight marker lacks a usable repro: {marks[0]}"
+                )
+            dumps = os.path.join(control_state, "false_verdicts")
+            if not (
+                os.path.isdir(dumps)
+                and any(p.endswith(".jsonl") for p in os.listdir(dumps))
+            ):
+                failures.append("control: offending history was not saved")
+            summary["control"] = {
+                "exit": rc,
+                "alerts": sink.alertnames().count("checker_false_verdict"),
+                "flight_markers": len(marks),
+            }
+            print(
+                f"# control ok: exit {rc}, sentinel alert + flight marker "
+                "delivered",
+                file=sys.stderr,
+            )
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        sink.shutdown()
+        sink.server_close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["wall_s"] = round(time.monotonic() - t0, 2)
+    summary["failures"] = len(failures)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print(json.dumps({"soak_check": summary}, sort_keys=True))
+    if failures:
+        return 1
+    print("# soak_check: all assertions hold", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

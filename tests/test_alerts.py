@@ -115,6 +115,7 @@ def test_builtin_rules_page_on_breach_and_regression():
         "retrace_storm",
         "job_quarantined",
         "writer_degraded",
+        "checker_false_verdict",
     }
     assert all(r.severity == "page" for r in builtin_rules())
 

@@ -1,0 +1,246 @@
+"""Fault campaigns: phase timeline, ground-truth labels, deterministic replay.
+
+Two obligations per violation class:
+
+- *soundness*: whenever a label says ``expect=illegal`` the history really
+  is non-linearizable — both checker engines must agree (CPU oracle and
+  frontier), through the normal client path, not a hand-built event list;
+- *determinism*: the same (campaign, seed) reproduces the history
+  byte-for-byte with the same label and the same verdict (the replay
+  contract the false-verdict repro command depends on).
+"""
+
+import io
+import json
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier_auto
+from s2_verification_tpu.checker.oracle import CheckOutcome, check_events
+from s2_verification_tpu.collector.campaign import (
+    VIOLATION_CLASSES,
+    Campaign,
+    CampaignPhase,
+    builtin_campaigns,
+    collect_labeled,
+    collect_labeled_to_file,
+    get_campaign,
+    label_path_for,
+)
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.utils import events as ev
+
+_QUIET = FaultPlan(min_latency=0.001, max_latency=0.003)
+
+LEGAL = [n for n, c in builtin_campaigns().items() if c.violation_class() is None]
+ILLEGAL = [
+    n for n, c in builtin_campaigns().items() if c.violation_class() is not None
+]
+
+
+def small_campaign(cls: str) -> Campaign:
+    """A short two-phase campaign arming one class — small enough that the
+    exhaustive CPU oracle answers instantly."""
+    return Campaign(
+        name=f"t-{cls}",
+        workflow="fencing" if cls == "fence_resurrect" else "regular",
+        clients=3,
+        ops=16,
+        phases=(
+            CampaignPhase("warm", 0.02, faults=_QUIET),
+            CampaignPhase("violate", 1.0, faults=_QUIET, violation=cls),
+        ),
+    )
+
+
+# -- timeline ----------------------------------------------------------------
+
+
+def test_phase_at_walks_the_timeline_and_clamps():
+    c = Campaign(
+        name="t",
+        phases=(
+            CampaignPhase("a", 1.0),
+            CampaignPhase("b", 2.0),
+            CampaignPhase("c", 5.0),
+        ),
+    )
+    assert c.phase_at(0.0)[1].name == "a"
+    assert c.phase_at(0.999)[1].name == "a"
+    assert c.phase_at(1.0)[1].name == "b"
+    assert c.phase_at(2.9)[1].name == "b"
+    assert c.phase_at(3.0)[1].name == "c"
+    # The last phase clamps forever — virtual time may outrun the sum.
+    assert c.phase_at(1e9) == (2, c.phases[2])
+
+
+def test_single_phase_covers_everything():
+    c = Campaign(name="t", phases=(CampaignPhase("only", 0.01),))
+    assert c.phase_at(0.0)[0] == 0
+    assert c.phase_at(123.0)[0] == 0
+
+
+def test_campaign_validation():
+    with pytest.raises(ValueError):
+        Campaign(name="empty", phases=())
+    with pytest.raises(ValueError):
+        Campaign(
+            name="two",
+            phases=(
+                CampaignPhase("a", 0.1, violation="drop_acked"),
+                CampaignPhase("b", 0.1, violation="reorder"),
+            ),
+        )
+    with pytest.raises(ValueError):
+        Campaign(
+            name="bogus", phases=(CampaignPhase("a", 0.1, violation="nope"),)
+        )
+
+
+def test_get_campaign_unknown_lists_known():
+    with pytest.raises(KeyError, match="steady"):
+        get_campaign("no-such-campaign")
+
+
+def test_builtin_matrix_covers_every_violation_class_once():
+    armed = [
+        c.violation_class()
+        for c in builtin_campaigns().values()
+        if c.violation_class() is not None
+    ]
+    assert sorted(armed) == sorted(VIOLATION_CLASSES)
+
+
+# -- soundness: legal campaigns stay legal -----------------------------------
+
+
+@pytest.mark.parametrize("name", sorted(LEGAL))
+def test_legal_campaigns_check_ok(name):
+    events, label = collect_labeled(get_campaign(name), seed=11)
+    assert label["expect"] == "legal"
+    assert not label["fired"]
+    assert len(events) > 20
+    res = check_frontier_auto(prepare(events))
+    assert res.outcome == CheckOutcome.OK, f"{name}: {res.outcome}"
+
+
+# -- soundness: every violation class is provably illegal --------------------
+
+
+@pytest.mark.parametrize("name", sorted(ILLEGAL))
+def test_builtin_violation_campaigns_fire_and_verdict_illegal(name):
+    events, label = collect_labeled(get_campaign(name), seed=11)
+    assert label["fired"] and label["confirmed"]
+    assert label["expect"] == "illegal"
+    assert label["detail"]["class"] == get_campaign(name).violation_class()
+    res = check_frontier_auto(prepare(events))
+    assert res.outcome == CheckOutcome.ILLEGAL, f"{name}: {res.outcome}"
+
+
+@pytest.mark.parametrize("cls", VIOLATION_CLASSES)
+@pytest.mark.parametrize("seed", [1, 7, 11])
+def test_violations_illegal_under_cpu_oracle_and_frontier(cls, seed):
+    # The normal client path end to end: workload clients against the
+    # campaign stream, then BOTH engines on the same events.
+    events, label = collect_labeled(small_campaign(cls), seed)
+    assert label["expect"] == "illegal", label
+    assert check_events(events).outcome == CheckOutcome.ILLEGAL
+    assert check_frontier_auto(prepare(events)).outcome == CheckOutcome.ILLEGAL
+
+
+# -- determinism: the replay contract ----------------------------------------
+
+
+def _collect_bytes(name: str, seed: int) -> tuple[str, dict, CheckOutcome]:
+    events, label = collect_labeled(get_campaign(name), seed)
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    return buf.getvalue(), label, check_frontier_auto(prepare(events)).outcome
+
+
+@pytest.mark.parametrize("name", ["ack-storm", "drop-acked", "fence-resurrect"])
+def test_replay_is_byte_identical_with_identical_verdicts(name):
+    a_text, a_label, a_verdict = _collect_bytes(name, seed=11)
+    b_text, b_label, b_verdict = _collect_bytes(name, seed=11)
+    assert a_text == b_text
+    assert a_label == b_label
+    assert a_verdict == b_verdict
+    assert a_text.strip(), "history must be non-empty"
+
+
+def test_distinct_seeds_produce_distinct_histories():
+    a_text, _, _ = _collect_bytes("steady", seed=1)
+    b_text, _, _ = _collect_bytes("steady", seed=2)
+    assert a_text != b_text
+
+
+@pytest.mark.slow
+def test_full_matrix_labels_match_verdicts():
+    # The soak invariant offline: every builtin campaign's label agrees
+    # with the frontier verdict across seeds.
+    table = {}
+    for name in sorted(builtin_campaigns()):
+        for seed in (1, 11):
+            events, label = collect_labeled(get_campaign(name), seed)
+            if label["expect"] == "unknown":
+                continue
+            got = check_frontier_auto(prepare(events)).outcome
+            want = (
+                CheckOutcome.ILLEGAL
+                if label["expect"] == "illegal"
+                else CheckOutcome.OK
+            )
+            assert got == want, f"{name} seed={seed}: {label['expect']} vs {got}"
+            table[(name, seed)] = got
+    assert table
+
+
+# -- streaming + sidecar -----------------------------------------------------
+
+
+def test_streaming_file_matches_buffered_bytes_and_sidecar(tmp_path):
+    c = get_campaign("stale-read")
+    path, lpath, label = collect_labeled_to_file(c, seed=11, out_dir=str(tmp_path))
+    assert lpath == label_path_for(path)
+    with open(path, encoding="utf-8") as f:
+        streamed = f.read()
+    buffered, mem_label, _ = _collect_bytes("stale-read", seed=11)
+    # The incremental writer and the in-memory path share one encoder:
+    # identical bytes, identical label.
+    assert streamed == buffered
+    assert mem_label == label
+    with open(lpath, encoding="utf-8") as f:
+        assert json.load(f) == label
+    assert label["expect"] == "illegal"
+
+
+def test_collect_to_file_streams_incrementally(tmp_path):
+    # The file grows while the run is still in flight: the sink hands each
+    # event to the writer as it happens instead of buffering the history.
+    from s2_verification_tpu.collector.workloads import HistorySink
+
+    chunks = []
+
+    class SpyWriter:
+        def write(self, s: str) -> int:
+            chunks.append(s)
+            return len(s)
+
+    sink = HistorySink(writer=SpyWriter())
+    from helpers import H
+
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    lines = []
+    for le in h.events:
+        n = len(chunks)
+        sink.send(le)
+        # Each event reaches the writer before the next send: O(window)
+        # memory, not an end-of-run flush of the whole history.
+        assert len(chunks) > n
+        lines.append("".join(chunks[n:]))
+        assert lines[-1].endswith("\n")
+    assert sink.count == len(h.events)
+    assert lines == [ev.encode_event(le) + "\n" for le in h.events]
+    assert sink.events == [], "writer-backed sink must not buffer"

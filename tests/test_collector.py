@@ -197,6 +197,21 @@ def test_stream_reuse_across_collections_does_not_deadlock():
     assert check(prepare(second)).ok
 
 
+def test_violating_stream_yields_illegal_history():
+    # The dual of every test above: when the service itself cheats (here: a
+    # campaign stream that acks an append without applying it), the same
+    # client path must produce a history the checker REJECTS — the collector
+    # is a witness, not a launderer.
+    from s2_verification_tpu.collector.campaign import (
+        collect_labeled,
+        get_campaign,
+    )
+
+    events, label = collect_labeled(get_campaign("drop-acked"), seed=11)
+    assert label["expect"] == "illegal"
+    assert check_events(events).outcome == CheckOutcome.ILLEGAL
+
+
 def test_transport_seam_structural():
     # VERDICT r2 #8: the workloads are typed against the transport seam;
     # the fake satisfies it structurally (no inheritance), so a

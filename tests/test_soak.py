@@ -1,0 +1,215 @@
+"""Closed-loop soak: labeled campaigns scored against a live daemon.
+
+The runner's one job is honest bookkeeping: a verdict that matches its
+ground-truth label is ``ok``, a contradiction is a ``false_verdict`` that
+must page + dump + exit nonzero, and anything the loop cannot score
+(UNKNOWN, lost submits, unconfirmed labels) must surface as its own
+outcome instead of passing silently.
+"""
+
+import http.server
+import io
+import json
+import os
+import threading
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.collector.adversarial import adversarial_events
+from s2_verification_tpu.collector.campaign import collect_labeled, get_campaign
+from s2_verification_tpu.obs.flight import read_flight
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.soak import (
+    SoakConfig,
+    SoakRunner,
+    repro_command,
+    soak_exit_code,
+)
+from s2_verification_tpu.utils import events as ev
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("soak-daemon")
+    cfg = VerifydConfig(
+        socket_path=str(tmp / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=30.0,
+        out_dir=str(tmp / "viz"),
+        stats_log=str(tmp / "stats.jsonl"),
+    )
+    with Verifyd(cfg):
+        yield cfg
+
+
+def _scfg(daemon, **kw) -> SoakConfig:
+    base = dict(address=daemon.socket_path, seed=11, retries=3, backoff_s=0.05)
+    base.update(kw)
+    return SoakConfig(**base)
+
+
+# -- scoring -----------------------------------------------------------------
+
+
+def test_clean_run_scores_every_label(daemon):
+    runner = SoakRunner(_scfg(daemon, campaigns=("steady", "drop-acked")))
+    summary = runner.run()
+    assert soak_exit_code(summary) == 0
+    assert summary["verdict_table"] == {
+        "legal->legal": 1,
+        "illegal->illegal": 1,
+    }
+    assert summary["ok"] == summary["submitted"] == 2
+    assert not summary["false_verdicts"] and not summary["submit_errors"]
+    assert runner._m_verdicts.value(expected="illegal", actual="illegal") == 1
+
+
+def test_schedule_is_deterministic_and_cycle_spread():
+    cfg = SoakConfig(address="ignored", campaigns=("a", "b"), seed=5, cycles=2)
+    sched = SoakRunner(cfg).schedule()
+    assert sched == SoakRunner(cfg).schedule()
+    assert len(sched) == 4
+    assert len({s for _, s in sched}) == 4, "every run gets a distinct seed"
+
+
+def test_soak_exit_code_taxonomy():
+    clean = dict(false_verdicts=[], submit_errors=[], inconclusive=0, unlabeled=0)
+    assert soak_exit_code(clean) == 0
+    assert soak_exit_code({**clean, "false_verdicts": [{}]}) == 1
+    assert soak_exit_code({**clean, "submit_errors": [{}]}) == 3
+    assert soak_exit_code({**clean, "inconclusive": 1}) == 3
+    assert soak_exit_code({**clean, "unlabeled": 1}) == 3
+
+
+def test_unreachable_daemon_is_a_lost_submit_not_a_crash(tmp_path):
+    cfg = SoakConfig(
+        address=str(tmp_path / "nobody-home.sock"),
+        campaigns=("steady",),
+        seed=11,
+        retries=1,
+        backoff_s=0.01,
+    )
+    summary = SoakRunner(cfg).run()
+    assert soak_exit_code(summary) == 3
+    assert len(summary["submit_errors"]) == 1
+    assert summary["results"][0]["outcome"] == "submit_error"
+
+
+# -- the sentinel ------------------------------------------------------------
+
+
+class _Sink(http.server.ThreadingHTTPServer):
+    def __init__(self):
+        self.alerts = []
+        sink = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                # Alertmanager v1 webhook shape: a JSON list of alerts.
+                sink.alerts.extend(body if isinstance(body, list) else [])
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *_):
+                pass
+
+        super().__init__(("127.0.0.1", 0), Handler)
+        self.daemon_threads = True
+        threading.Thread(target=self.serve_forever, daemon=True).start()
+
+
+def test_mislabeled_control_fires_the_false_verdict_path(daemon, tmp_path):
+    sink = _Sink()
+    try:
+        state = str(tmp_path / "state")
+        runner = SoakRunner(
+            _scfg(
+                daemon,
+                campaigns=("steady",),
+                mislabel_first=True,
+                alert_url=f"http://127.0.0.1:{sink.server_address[1]}/alerts",
+                state_dir=state,
+            )
+        )
+        summary = runner.run()
+        assert soak_exit_code(summary) == 1
+        (row,) = summary["false_verdicts"]
+        assert row["control"] and row["expect"] == "illegal"
+        assert row["actual"] == "legal"
+        assert runner._m_false.value(campaign="steady") == 1
+        # Webhook: the builtin checker_false_verdict alert was delivered.
+        names = [a.get("labels", {}).get("alertname") for a in sink.alerts]
+        assert "checker_false_verdict" in names
+        # Flight marker: fingerprint + repro for one-command reproduction.
+        marks = [
+            m
+            for m in read_flight(state)
+            if m.get("k") == "dump" and m.get("reason") == "checker_false_verdict"
+        ]
+        assert len(marks) == 1
+        assert marks[0]["fingerprint"] == row["fingerprint"]
+        assert "--campaign steady --seed" in marks[0]["repro"]
+        # The offending history + label landed on disk.
+        d = os.path.join(state, "false_verdicts")
+        saved = sorted(os.listdir(d))
+        assert any(p.endswith(".jsonl") for p in saved)
+        assert any(p.endswith(".label.json") for p in saved)
+    finally:
+        sink.shutdown()
+        sink.server_close()
+
+
+def test_repro_command_regenerates_the_flagged_bytes():
+    events, label = collect_labeled(get_campaign("reorder"), seed=11)
+    fp = history_fingerprint(prepare(events))
+    cmd = repro_command(label)
+    assert "--campaign reorder --seed 11" in cmd
+    # Replaying the label's (campaign, seed, sizing) reproduces the exact
+    # fingerprint the sentinel flagged.
+    again, _ = collect_labeled(
+        get_campaign(label["campaign"]),
+        label["seed"],
+        clients=label["clients"],
+        ops=label["ops"],
+    )
+    assert history_fingerprint(prepare(again)) == fp
+
+
+# -- adversarial histories through the live daemon ---------------------------
+
+
+def test_unsatisfiable_adversarial_history_is_illegal_via_submit(daemon):
+    events = adversarial_events(5, batch=4, seed=2, unsatisfiable=True)
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    client = VerifydClient(daemon.socket_path, timeout=60)
+    reply = client.submit_with_retry(buf.getvalue(), client="test", no_viz=True)
+    assert int(reply["verdict"]) == 1, reply  # ILLEGAL
+
+
+def test_satisfiable_adversarial_history_is_legal_via_submit(daemon):
+    events = adversarial_events(5, batch=4, seed=2)
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    client = VerifydClient(daemon.socket_path, timeout=60)
+    reply = client.submit_with_retry(buf.getvalue(), client="test", no_viz=True)
+    assert int(reply["verdict"]) == 0, reply  # OK
+
+
+# -- the full matrix (slow: soak_check covers this against a fleet) ----------
+
+
+@pytest.mark.slow
+def test_full_builtin_matrix_clean_against_daemon(daemon, tmp_path):
+    runner = SoakRunner(
+        _scfg(daemon, state_dir=str(tmp_path / "state"))
+    )
+    summary = runner.run()
+    assert soak_exit_code(summary) == 0, summary["verdict_table"]
+    assert summary["verdict_table"].get("illegal->illegal") == 4
