@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak perfgate clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak perfgate lint clean
 
 all: native
 
@@ -17,7 +17,7 @@ fuzz: native  ## deep cross-engine differential soak (set TRIALS=N, default 300)
 # Marker-based selection (the tier-1 discipline): tests opt out via
 # @pytest.mark.slow instead of maintaining a -k name blocklist, and a
 # module that fails to import is reported rather than aborting the run.
-test: native
+test: native lint
 	$(PYTHON) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors
 
 test-fast: native
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: obs mesh fleet overload soak
+chaos-full: lint obs mesh fleet overload soak
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -78,6 +78,15 @@ overload:
 # checker_false_verdict alert, dump a flight marker, and exit nonzero.
 soak:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_check.py
+
+# Static-analysis gate (verifylint, s2_verification_tpu/analysis/):
+# five domain-aware passes over the whole package — jit-hygiene,
+# event-schema, metrics-cardinality, concurrency, protocol-compat.
+# Exits nonzero on any error not in .verifylint-baseline.json and when
+# docs/EVENTS.md drifts from the event registry.
+lint:
+	JAX_PLATFORMS=cpu $(PYTHON) -m s2_verification_tpu.cli lint
+	JAX_PLATFORMS=cpu $(PYTHON) -m s2_verification_tpu.cli lint --check-events-md
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

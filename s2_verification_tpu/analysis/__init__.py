@@ -1,0 +1,38 @@
+"""verifylint — domain-aware static analysis for the serving + obs stack.
+
+Zero-dependency ``ast``-level passes encoding the repo's invariants:
+
+- ``jit_hygiene``        — every module-level jit/pmap product routes through
+                           ``observe_jit``; no jit-in-loop, no unhashable
+                           ``static_argnums``, no Python ``if`` on traced values.
+- ``event_schema``       — the ServiceStats event registry (name × field set),
+                           cross-checked against every consumer: ``stats.py``
+                           counters, ``AlertRule`` literals, flight/doctor and
+                           archive/sentinel ``observe_event`` branches.
+- ``metrics_cardinality``— metric label values must be provably drawn from
+                           closed literal sets; naming lint for the
+                           ``verifyd_*`` / ``_total`` / ``_seconds`` conventions.
+- ``concurrency``        — in thread-spawning classes, attributes reachable
+                           from ≥2 thread entry points must be written under a
+                           held ``self._lock``-style context.
+- ``protocol_compat``    — frame construction and parse sites in
+                           ``client.py``/``daemon.py``/``router.py`` must agree
+                           with ``protocol.py``'s ``FRAME_FIELDS`` table, and
+                           the HMAC must cover everything but ``UNSIGNED_FIELDS``.
+
+Entry points: the ``lint`` CLI subcommand, ``make lint``, and
+``scripts/lint_check.py`` (the fixture-corpus gate).
+"""
+
+from .engine import (  # noqa: F401
+    ERROR,
+    INFO,
+    WARNING,
+    Finding,
+    LintEngine,
+    RunResult,
+    apply_baseline,
+    default_passes,
+    load_baseline,
+    write_baseline,
+)

@@ -1,0 +1,263 @@
+"""jit-hygiene: static companions to the runtime retrace-storm detector.
+
+Rules
+-----
+
+``jit-unwrapped`` (error)
+    Every module-level ``jax.jit`` / ``jax.pmap`` product (decorated def or
+    ``name = jax.jit(f)`` assignment) must be rebound through the
+    ``JitIntrospector`` wrapper — ``name = observe_jit("site")(name)`` — or
+    carry an ``@observe_jit(...)`` decorator.  Unwrapped sites are invisible
+    to compile/retrace tracking, so a retrace storm there never alerts.
+    Inline ``jax.vmap`` inside an already-jitted function is exempt (it is
+    traced as part of the enclosing jit, which *is* wrapped).
+
+``jit-in-loop`` (error)
+    Calling ``jax.jit``/``jax.pmap`` inside a ``for``/``while`` body builds a
+    fresh transform (and usually a fresh compile) per iteration — the exact
+    failure mode the retrace-storm alert pages on, caught before commit.
+
+``jit-unhashable-static`` (error)
+    ``static_argnums`` / ``static_argnames`` given as a list/set/dict display.
+    jax hashes static arguments into the compile cache key; unhashable
+    containers raise at call time on cache-miss paths only.
+
+``jit-traced-branch`` (error)
+    A Python ``if``/``while`` test inside a jitted function that reads a
+    non-static parameter directly.  Branching on a traced value raises
+    ``TracerBoolConversionError`` at trace time (or silently bakes in one
+    branch under ``concrete``).  Shape/dtype/ndim attribute reads and
+    ``len``/``isinstance`` calls are static and allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import ERROR, FileInfo, FilePass, Finding, dotted_name
+
+_JIT_NAMES = {"jax.jit", "jax.pmap", "jit", "pmap"}
+_STATIC_KWARGS = ("static_argnums", "static_argnames")
+_ALLOWED_CALLS = {"len", "isinstance", "getattr", "hasattr", "callable"}
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _is_jit_func(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name in _JIT_NAMES
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The ``jax.jit(...)``/``partial(jax.jit, ...)`` call inside ``node``,
+    if ``node`` is a jit transform application or a partial thereof."""
+    if not isinstance(node, ast.Call):
+        return None
+    if _is_jit_func(node.func):
+        return node
+    fname = dotted_name(node.func)
+    if fname in ("partial", "functools.partial") and node.args and _is_jit_func(node.args[0]):
+        return node
+    return None
+
+
+def _decorator_jit(dec: ast.AST) -> ast.Call | None:
+    """jit info for a decorator node: bare ``@jax.jit`` or ``@partial(jax.jit,…)``."""
+    if _is_jit_func(dec):
+        return ast.Call(func=dec, args=[], keywords=[])  # synthetic, no kwargs
+    return _jit_call(dec)
+
+
+def _static_param_names(call: ast.Call, fn: ast.FunctionDef) -> set[str]:
+    params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+    static: set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                static.add(kw.value.value)
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        static.add(el.value)
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, int):
+                nums = [kw.value.value]
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                nums = [
+                    el.value
+                    for el in kw.value.elts
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int)
+                ]
+            for n in nums:
+                if 0 <= n < len(params):
+                    static.add(params[n])
+    return static
+
+
+def _is_observe_wrap(node: ast.expr, target: str) -> bool:
+    """``observe_jit("site")(target)`` — the wrapper rebind."""
+    if not isinstance(node, ast.Call) or len(node.args) != 1:
+        return False
+    arg = node.args[0]
+    if not (isinstance(arg, ast.Name) and arg.id == target):
+        return False
+    inner = node.func
+    return isinstance(inner, ast.Call) and dotted_name(inner.func) in (
+        "observe_jit",
+        "introspect.observe_jit",
+    )
+
+
+class JitHygienePass(FilePass):
+    name = "jit-hygiene"
+
+    def check_file(self, info: FileInfo) -> list[Finding]:
+        tree = info.tree
+        assert tree is not None
+        src = info.text
+        if "jax" not in src:
+            return []
+        out: list[Finding] = []
+
+        # --- collect module-level jit products and observe_jit rebinds -----
+        jit_products: dict[str, tuple[int, ast.Call]] = {}  # name -> (line, call)
+        wrapped: set[str] = set()
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    call = _decorator_jit(dec)
+                    if call is not None:
+                        jit_products[node.name] = (node.lineno, call)
+                    if dotted_name(dec) == "observe_jit" or (
+                        isinstance(dec, ast.Call) and dotted_name(dec.func) == "observe_jit"
+                    ):
+                        wrapped.add(node.name)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if not isinstance(t, ast.Name):
+                    continue
+                call = _jit_call(node.value)
+                if call is not None:
+                    jit_products[t.id] = (node.lineno, call)
+                if _is_observe_wrap(node.value, t.id):
+                    wrapped.add(t.id)
+
+        for name, (line, _call) in sorted(jit_products.items()):
+            if name not in wrapped:
+                out.append(
+                    Finding(
+                        "jit-unwrapped",
+                        ERROR,
+                        info.rel,
+                        line,
+                        f"jit product '{name}' is not routed through observe_jit() — "
+                        "compiles/retraces here are invisible to the introspector",
+                    )
+                )
+
+        # --- jit-in-loop + unhashable statics + traced branches ------------
+        in_loop: set[int] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                for sub in ast.walk(node):
+                    if sub is not node and isinstance(sub, ast.Call):
+                        call = _jit_call(sub)
+                        if call is not None and id(call) not in in_loop:
+                            in_loop.add(id(call))
+                            out.append(
+                                Finding(
+                                    "jit-in-loop",
+                                    ERROR,
+                                    info.rel,
+                                    sub.lineno,
+                                    "jax.jit/pmap applied inside a loop body builds a "
+                                    "new transform (and compile) every iteration",
+                                )
+                            )
+            call = _jit_call(node) if isinstance(node, ast.Call) else None
+            if call is not None:
+                for kw in call.keywords:
+                    if kw.arg in _STATIC_KWARGS and isinstance(
+                        kw.value, (ast.List, ast.Set, ast.Dict)
+                    ):
+                        out.append(
+                            Finding(
+                                "jit-unhashable-static",
+                                ERROR,
+                                info.rel,
+                                kw.value.lineno,
+                                f"{kw.arg} given as an unhashable "
+                                f"{type(kw.value).__name__.lower()} display — jax hashes "
+                                "static args into the compile cache key; use a tuple",
+                            )
+                        )
+
+        # traced-branch: inspect bodies of jit-decorated module functions
+        for node in tree.body:
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            call = None
+            for dec in node.decorator_list:
+                call = _decorator_jit(dec) or call
+            if call is None:
+                continue
+            static = _static_param_names(call, node)
+            params = {
+                a.arg
+                for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+            } - static - {"self"}
+            out.extend(self._traced_branches(info, node, params))
+        return out
+
+    def _traced_branches(
+        self, info: FileInfo, fn: ast.FunctionDef, traced: set[str]
+    ) -> list[Finding]:
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            name = self._offending_name(node.test, traced)
+            if name:
+                out.append(
+                    Finding(
+                        "jit-traced-branch",
+                        ERROR,
+                        info.rel,
+                        node.lineno,
+                        f"Python branch on traced parameter '{name}' inside jitted "
+                        f"'{fn.name}' — raises at trace time; use lax.cond/select or "
+                        "mark the arg static",
+                    )
+                )
+        return out
+
+    def _offending_name(self, test: ast.expr, traced: set[str]) -> str | None:
+        """A traced param read *as a value* in the test — excluding static
+        contexts: ``x.shape``-style attribute reads, ``len(x)``, subscript
+        bases, and comparisons of those."""
+        skip: set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+                for sub in ast.walk(node.value):
+                    skip.add(id(sub))
+            elif isinstance(node, ast.Call):
+                if dotted_name(node.func) in _ALLOWED_CALLS:
+                    for arg in node.args:
+                        for sub in ast.walk(arg):
+                            skip.add(id(sub))
+                else:
+                    # any other call on a traced value yields a traced value;
+                    # the Name itself inside the call is what we flag
+                    pass
+            elif isinstance(node, ast.Subscript):
+                # x[0] on a traced value is traced — do not skip
+                pass
+        for node in ast.walk(test):
+            if (
+                isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in traced
+                and id(node) not in skip
+            ):
+                return node.id
+        return None

@@ -1438,6 +1438,143 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     return code
 
 
+def _lint_root() -> str:
+    """The repo root: the directory holding the package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_changed_paths(root: str) -> list[str] | None:
+    """git-diff-scoped .py paths (worktree + index + untracked), or None
+    when git is unavailable — caller falls back to the full tree."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=10,
+            check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    paths: list[str] = []
+    for line in out.splitlines():
+        if len(line) < 4:
+            continue
+        p = line[3:].split(" -> ")[-1].strip().strip('"')
+        if p.endswith(".py") and p.startswith("s2_verification_tpu/"):
+            if os.path.exists(os.path.join(root, p)):
+                paths.append(p)
+    return paths
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from .analysis import LintEngine, load_baseline, write_baseline
+    from .analysis.engine import apply_baseline, discover_files
+    from .analysis.event_schema import render_events_md
+    from .analysis.engine import TreeContext
+
+    root = _lint_root()
+    baseline_path = args.baseline or os.path.join(root, ".verifylint-baseline.json")
+    cache_path = None if args.no_cache else os.path.join(root, ".verifylint-cache.json")
+
+    if args.changed:
+        rels = _lint_changed_paths(root)
+        if rels is None:
+            log.warning("lint --changed: git unavailable, scanning the full tree")
+            rels = discover_files(root, args.paths or None)
+    else:
+        rels = discover_files(root, args.paths or None)
+
+    # --events-md / --check-events-md always read the whole package —
+    # a partial scan would render a partial registry.
+    if args.events_md is not None or args.check_events_md:
+        ctx = TreeContext(root, discover_files(root, None))
+        rendered = render_events_md(ctx)
+        if args.events_md is not None:
+            if args.events_md == "-":
+                sys.stdout.write(rendered)
+            else:
+                out_path = (
+                    args.events_md
+                    if os.path.isabs(args.events_md)
+                    else os.path.join(root, args.events_md)
+                )
+                with open(out_path, "w", encoding="utf-8") as f:
+                    f.write(rendered)
+                print(f"wrote {out_path}")
+        if args.check_events_md:
+            committed = os.path.join(root, "docs", "EVENTS.md")
+            try:
+                with open(committed, encoding="utf-8") as f:
+                    on_disk = f.read()
+            except OSError:
+                on_disk = ""
+            if on_disk != rendered:
+                log.error(
+                    "docs/EVENTS.md is stale — regenerate with "
+                    "`lint --events-md docs/EVENTS.md`"
+                )
+                return 1
+            print("docs/EVENTS.md is up to date")
+        return 0
+
+    full_tree = not args.changed and not args.paths
+    engine = LintEngine(root, cache_path=cache_path)
+    res = engine.run(rel_paths=rels)
+
+    if args.write_baseline:
+        if not full_tree:
+            log.error("--write-baseline needs a full-tree run (no --changed/paths)")
+            return USAGE_EXIT
+        write_baseline(res.findings, baseline_path)
+        print(
+            f"wrote {baseline_path} "
+            f"({sum(1 for f in res.findings if f.severity == 'error')} errors baselined; "
+            "add a justification to every entry)"
+        )
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    ratchet = apply_baseline(res.findings, baseline)
+
+    if args.json:
+        doc = {
+            "files": res.files,
+            "suppressed": res.suppressed,
+            "cache_hits": res.cache_hits,
+            "findings": [f.to_dict() for f in res.findings],
+            "new_errors": [f.to_dict() for f in ratchet.new_errors],
+            "baselined": len(ratchet.baselined),
+            "stale_baseline_keys": ratchet.stale_keys,
+        }
+        print(_json.dumps(doc, indent=2))
+    else:
+        baselined_keys = {f.key for f in ratchet.baselined}
+        shown = 0
+        for f in res.findings:
+            tag = " (baselined)" if f.severity == "error" and f.key in baselined_keys else ""
+            print(f"{f.path}:{f.line}: {f.severity}: [{f.rule}] {f.message}{tag}")
+            shown += 1
+        n_err = sum(1 for f in res.findings if f.severity == "error")
+        print(
+            f"{shown} finding(s) in {res.files} file(s): {n_err} error(s) "
+            f"({len(ratchet.new_errors)} new, {len(ratchet.baselined)} baselined), "
+            f"{res.suppressed} suppressed, {res.cache_hits} cache hit(s)"
+        )
+        if full_tree:
+            for key in ratchet.stale_keys:
+                print(
+                    f"stale baseline entry (debt paid down — shrink with "
+                    f"--write-baseline): {key}"
+                )
+    return 1 if ratchet.new_errors else 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = _Parser(
         prog="s2-verification-tpu",
@@ -2390,6 +2527,55 @@ def build_parser() -> argparse.ArgumentParser:
         "compact summary line)",
     )
     k.set_defaults(fn=_cmd_soak)
+
+    li = sub.add_parser(
+        "lint",
+        help="run verifylint, the domain-aware static-analysis suite "
+        "(jit-hygiene, event-schema, metrics-cardinality, concurrency, "
+        "protocol-compat)",
+    )
+    li.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to scan (default: the whole package)",
+    )
+    li.add_argument(
+        "--json", action="store_true", help="machine-readable findings + ratchet state"
+    )
+    li.add_argument(
+        "--baseline",
+        default=None,
+        metavar="PATH",
+        help="baseline ratchet file (default: <repo>/.verifylint-baseline.json)",
+    )
+    li.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from this run's error findings "
+        "(full-tree runs only; justify every kept entry)",
+    )
+    li.add_argument(
+        "--changed",
+        action="store_true",
+        help="scan only git-modified/untracked package files (sub-second "
+        "incremental gate)",
+    )
+    li.add_argument(
+        "--events-md",
+        default=None,
+        metavar="PATH",
+        help="render the event-schema registry as markdown to PATH "
+        "('-' = stdout) and exit",
+    )
+    li.add_argument(
+        "--check-events-md",
+        action="store_true",
+        help="fail if the committed docs/EVENTS.md is stale",
+    )
+    li.add_argument(
+        "--no-cache", action="store_true", help="ignore and skip the per-file cache"
+    )
+    li.set_defaults(fn=_cmd_lint)
     return p
 
 

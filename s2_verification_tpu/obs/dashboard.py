@@ -121,17 +121,23 @@ class Dashboard:
         now = self._time()
         completed = _counter_total(self.registry, "verifyd_jobs_completed_total")
         compiles = _counter_total(self.registry, "verifyd_jit_compiles_total")
-        dt = (now - self._prev_t) if self._prev_t is not None else None
-        throughput = 0.0
-        compile_rate = 0.0
-        if dt and dt > 0:
-            throughput = max(0.0, completed - (self._prev_completed or 0.0)) / dt
-            compile_rate = max(0.0, compiles - (self._prev_compiles or 0.0))
-        self._prev_t, self._prev_completed, self._prev_compiles = (
-            now,
-            completed,
-            compiles,
-        )
+        # sample_once is both the sampler thread's tick body and a public
+        # entry (the /dashboard handler samples inline when the ring is
+        # cold): the prev_* delta baseline is a read-then-write, so an
+        # interleaved pair of calls would both diff against the same
+        # baseline and double-count the interval's throughput.
+        with self._lock:
+            dt = (now - self._prev_t) if self._prev_t is not None else None
+            throughput = 0.0
+            compile_rate = 0.0
+            if dt and dt > 0:
+                throughput = max(0.0, completed - (self._prev_completed or 0.0)) / dt
+                compile_rate = max(0.0, compiles - (self._prev_compiles or 0.0))
+            self._prev_t, self._prev_completed, self._prev_compiles = (
+                now,
+                completed,
+                compiles,
+            )
         burn = 0.0
         if self.health is not None:
             try:

@@ -132,6 +132,12 @@ class HealthProber:
         self.on_change = on_change
         #: last observation per target (None = never probed)
         self.status: Dict[str, Optional[bool]] = {n: None for n in self._probes}
+        # probe_once is both the poller thread's tick body and a public
+        # entry (router failover calls it inline on a routing miss): the
+        # transition read-modify-write on ``status`` must not interleave,
+        # or both callers observe the same ``prev`` and double-fire
+        # on_change for one transition.
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -144,8 +150,9 @@ class HealthProber:
             except Exception:
                 up = False
             out[name] = up
-            prev = self.status.get(name)
-            self.status[name] = up
+            with self._lock:
+                prev = self.status.get(name)
+                self.status[name] = up
             if up != prev and self.on_change is not None:
                 try:
                     self.on_change(name, up)

@@ -780,7 +780,13 @@ class Verifyd:
                                 # *written* — an accepted job whose verdict
                                 # never reached the client is a lost job.
                                 inflight = True
-                                self._inflight += 1
+                                # Single-threaded by construction: every
+                                # _handle coroutine runs on the accept
+                                # loop's event loop, so +=/-= never
+                                # interleave; the drain poller thread only
+                                # reads the counter (a stale read just
+                                # re-polls).
+                                self._inflight += 1  # verifylint: disable=concurrency-unlocked-write
                             resp = await self._dispatch(req, reader)
                     await self._reply(writer, resp, secret)
                 finally:

@@ -175,6 +175,49 @@ EXIT_UNAVAILABLE = 69  # EX_UNAVAILABLE: no daemon ever answered a connect
 EXIT_PROTOCOL = 76  # EX_PROTOCOL: daemon reached but refused after retries
 
 
+#: Request-frame field table: op -> {field: "required" | "optional"}.
+#: ``op`` itself and the fields in :data:`UNSIGNED_FIELDS` ride on every
+#: frame implicitly.  This is the wire contract the static protocol-compat
+#: lint pass checks construction sites (client.py) and parse sites
+#: (daemon.py/router.py) against: a field added here must be optional (old
+#: peers must keep interoperating — senders may omit it, parsers must
+#: ``.get`` it with a default), and because only :data:`UNSIGNED_FIELDS`
+#: escape the MAC, every new field is HMAC-covered by construction.
+FRAME_FIELDS = {
+    "ping": {},
+    "stats": {},
+    "trace": {},
+    "fleet": {},
+    "submit": {
+        "history": "required",
+        "client": "optional",
+        "priority": "optional",
+        "no_viz": "optional",
+        "deadline": "optional",
+        "trace": "optional",
+    },
+    "profiles": {
+        "shape": "optional",
+        "backend": "optional",
+        "client": "optional",
+        "verdict": "optional",
+        "since": "optional",
+        "slowest": "optional",
+        "limit": "optional",
+    },
+    "shutdown": {"drain": "optional", "timeout": "optional"},
+    "quarantine": {"action": "optional", "fingerprint": "optional"},
+    "drain": {"node": "required", "timeout": "optional"},
+    "undrain": {"node": "required"},
+}
+
+#: The only fields excluded from the HMAC canonicalization — the MAC
+#: itself.  Everything else in a frame is authenticated; extending this
+#: tuple widens the unauthenticated surface and fails the protocol-compat
+#: lint unless :func:`_frame_mac` agrees.
+UNSIGNED_FIELDS = ("auth",)
+
+
 def encode_frame(obj: dict) -> bytes:
     """One wire frame: compact JSON + newline (history text rides inside a
     JSON string, so embedded newlines are escaped and framing holds)."""
@@ -189,10 +232,10 @@ def decode_frame(line: bytes) -> dict:
 
 
 def _frame_mac(obj: dict, secret: bytes) -> str:
-    """HMAC-SHA256 over the canonical serialization of ``obj`` minus its
-    ``auth`` field.  Canonical = sorted keys + compact separators, so both
-    ends derive identical bytes regardless of insertion order."""
-    body = {k: v for k, v in obj.items() if k != "auth"}
+    """HMAC-SHA256 over the canonical serialization of ``obj`` minus
+    :data:`UNSIGNED_FIELDS`.  Canonical = sorted keys + compact separators,
+    so both ends derive identical bytes regardless of insertion order."""
+    body = {k: v for k, v in obj.items() if k not in UNSIGNED_FIELDS}
     canon = json.dumps(body, sort_keys=True, separators=(",", ":")).encode("utf-8")
     return _hmac.new(secret, canon, hashlib.sha256).hexdigest()
 

@@ -1,0 +1,177 @@
+"""Static-analysis gate: verifylint proven against itself.
+
+What it checks (the `make lint` companion — run directly or via
+`python scripts/lint_check.py`):
+
+1. **Real tree clean modulo baseline** — the full five-pass suite over
+   `s2_verification_tpu/` must produce zero error findings beyond
+   `.verifylint-baseline.json`, and every baselined key must still fire
+   (a stale key means the debt was paid — shrink the baseline);
+2. **Fixture corpus exactness** — every rule in the suite must fire on
+   the fixture mini-trees (`tests/fixtures/lint/tree*`) at *exactly* the
+   lines carrying `# expect: <rule>` annotations, and nowhere else.
+   This proves each detector both triggers and stays quiet: a pass that
+   silently stopped matching (or started over-matching) fails here even
+   though the real tree still looks green;
+3. **Suppressions counted** — the fixture corpus carries inline
+   `# verifylint: disable=` sites; they must be counted, not silently
+   dropped;
+4. **docs/EVENTS.md up to date** — the committed event-registry doc must
+   byte-match a fresh `lint --events-md` render of the tree.
+
+Exit 0 on success, 1 with a per-failure report otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from s2_verification_tpu.analysis import (  # noqa: E402
+    LintEngine,
+    apply_baseline,
+    load_baseline,
+)
+from s2_verification_tpu.analysis.event_schema import render_events_md  # noqa: E402
+from s2_verification_tpu.analysis.engine import TreeContext, discover_files  # noqa: E402
+
+FIXTURE_TREES = (
+    "tests/fixtures/lint/tree",
+    "tests/fixtures/lint/tree_notable",
+)
+#: fixture suppression sites, counted (tree, tree_notable)
+EXPECTED_SUPPRESSED = (4, 0)
+
+#: every rule the suite can emit must be exercised by the fixture corpus
+ALL_RULES = {
+    "jit-unwrapped",
+    "jit-in-loop",
+    "jit-unhashable-static",
+    "jit-traced-branch",
+    "metric-open-label",
+    "metric-name",
+    "concurrency-unlocked-write",
+    "event-never-emitted",
+    "event-field-unwritten",
+    "protocol-no-table",
+    "protocol-unknown-op",
+    "protocol-unknown-field",
+    "protocol-missing-required",
+    "protocol-unguarded-read",
+    "protocol-unsigned-mismatch",
+    "parse-error",
+}
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([\w\-, ]+?)\s*$")
+_EXPECT_FILE_RE = re.compile(r"#\s*expect-file:\s*([\w\-]+)")
+
+
+def fixture_expectations(root: str):
+    """((rel, line, rule) exact anchors, (rel, rule) file-level anchors)."""
+    exact, file_level = [], []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root).replace(os.sep, "/")
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    m = _EXPECT_RE.search(line)
+                    if m:
+                        for rule in m.group(1).split(","):
+                            exact.append((rel, i, rule.strip()))
+                        continue
+                    m = _EXPECT_FILE_RE.search(line)
+                    if m:
+                        file_level.append((rel, m.group(1)))
+    return exact, file_level
+
+
+def check_fixture_tree(tree_rel: str, expected_suppressed: int) -> list[str]:
+    root = os.path.join(REPO, tree_rel)
+    res = LintEngine(root).run(paths=["."])
+    got = [(f.path, f.line, f.rule) for f in res.findings]
+    exact, file_level = fixture_expectations(root)
+    problems: list[str] = []
+    unmatched = list(got)
+    for e in exact:
+        if e in unmatched:
+            unmatched.remove(e)
+        else:
+            problems.append(f"{tree_rel}: expected {e[2]} at {e[0]}:{e[1]}, did not fire")
+    for rel, rule in file_level:
+        hit = next((g for g in unmatched if g[0] == rel and g[2] == rule), None)
+        if hit is not None:
+            unmatched.remove(hit)
+        else:
+            problems.append(f"{tree_rel}: expected {rule} somewhere in {rel}, did not fire")
+    for path, line, rule in unmatched:
+        problems.append(f"{tree_rel}: unexpected {rule} at {path}:{line}")
+    if res.suppressed != expected_suppressed:
+        problems.append(
+            f"{tree_rel}: {res.suppressed} suppressions counted, "
+            f"expected {expected_suppressed}"
+        )
+    return problems
+
+
+def main() -> int:
+    problems: list[str] = []
+
+    # 1. real tree, baseline-ratcheted
+    engine = LintEngine(REPO)  # no cache: the gate always parses fresh
+    result = engine.run()
+    baseline = load_baseline(os.path.join(REPO, ".verifylint-baseline.json"))
+    ratchet = apply_baseline(result.errors, baseline)
+    for f in ratchet.new_errors:
+        problems.append(f"real tree: new error {f.rule} at {f.path}:{f.line}: {f.message}")
+    for key in ratchet.stale_keys:
+        problems.append(f"real tree: stale baseline key (debt paid — remove it): {key}")
+
+    # 2+3. fixture corpus: every rule, exactly where annotated, nowhere else
+    fixture_rules: set[str] = set()
+    for tree_rel, expected_suppressed in zip(FIXTURE_TREES, EXPECTED_SUPPRESSED):
+        root = os.path.join(REPO, tree_rel)
+        exact, file_level = fixture_expectations(root)
+        fixture_rules.update(r for _p, _l, r in exact)
+        fixture_rules.update(r for _p, r in file_level)
+        problems.extend(check_fixture_tree(tree_rel, expected_suppressed))
+    for rule in sorted(ALL_RULES - fixture_rules):
+        problems.append(f"fixture corpus exercises no '{rule}' trigger — add one")
+    for rule in sorted(fixture_rules - ALL_RULES):
+        problems.append(f"fixture corpus expects unknown rule '{rule}'")
+
+    # 4. docs/EVENTS.md must match a fresh render
+    ctx = TreeContext(REPO, discover_files(REPO))
+    want = render_events_md(ctx)
+    md_path = os.path.join(REPO, "docs", "EVENTS.md")
+    try:
+        with open(md_path, encoding="utf-8") as f:
+            have = f.read()
+    except OSError:
+        have = None
+    if have != want:
+        problems.append(
+            "docs/EVENTS.md is stale — regenerate with "
+            "`python -m s2_verification_tpu.cli lint --events-md docs/EVENTS.md`"
+        )
+
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}")
+        print(f"\nlint_check: {len(problems)} problem(s)")
+        return 1
+    print(
+        f"lint_check: real tree clean ({len(result.errors)} baselined error(s)), "
+        f"fixture corpus exact ({len(ALL_RULES)} rules), docs/EVENTS.md fresh"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+"""Fixture construction sites, one per protocol construction rule."""
+
+
+def build_ping():
+    return {"op": "ping"}
+
+
+def build_bad_op():
+    return {"op": "snapshot"}  # expect: protocol-unknown-op
+
+
+def build_unknown_field():
+    return {
+        "op": "submit",
+        "history": [],
+        "compression": "zstd",  # expect: protocol-unknown-field
+    }
+
+
+def build_missing_required():
+    return {"op": "submit", "client": "c1"}  # expect: protocol-missing-required
+
+
+def build_missing_required_suppressed():
+    return {"op": "submit"}  # verifylint: disable=protocol-missing-required
+
+
+def build_required_via_store():
+    req = {"op": "submit", "client": "c2"}
+    req["history"] = []
+    return req

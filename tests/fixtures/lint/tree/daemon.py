@@ -1,0 +1,12 @@
+"""Fixture parse sites, one per protocol read rule."""
+
+
+def handle(req):
+    history = req["history"]  # clean: required field, always present
+    compression = req.get("zcomp")  # expect: protocol-unknown-field
+    deadline = req["deadline"]  # expect: protocol-unguarded-read
+    client = req.get("client", "?")
+    guarded = None
+    if req.get("deadline") is not None:
+        guarded = req["deadline"]  # clean: guarded by req.get()
+    return history, compression, deadline, client, guarded

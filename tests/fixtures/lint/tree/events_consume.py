@@ -1,0 +1,34 @@
+"""Fixture event consumers: fold idiom, _count shape, AlertRule literals."""
+
+
+class AlertRule:
+    def __init__(self, event=None, field=None):
+        self.event = event
+        self.field = field
+
+
+def fold(ev):
+    name = ev.get("ev") or ev.get("event")
+    if name == "job_done":
+        return ev.get("verdict")  # clean: emitted field
+    if name == "job_failed":  # expect: event-never-emitted
+        return ev.get("reason")
+    if name == "cache_hit":
+        return ev.get("latency_s")  # expect: event-field-unwritten
+    if name == "open_evt":
+        return ev.get("anything")  # clean: open event, lower-bound fields
+    return None
+
+
+def _count(event, fields):
+    if event == "ghost_evt":  # expect: event-never-emitted
+        return fields.get("x")
+    if event == "job_done":
+        return fields.get("wall_s")  # clean: emitted field
+    return None
+
+
+RULES = [
+    AlertRule(event="job_done", field="verdict"),
+    AlertRule(event="vanished", field="x"),  # expect: event-never-emitted
+]

@@ -1,0 +1,7 @@
+"""Fixture event emitters: two closed events and one open event."""
+
+
+def produce(stats):
+    stats.emit("job_done", verdict="ok", wall_s=1.0)
+    stats.emit("cache_hit", job="j1")
+    stats.emit("open_evt", a=1, **{"b": 2})

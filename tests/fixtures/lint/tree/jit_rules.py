@@ -1,0 +1,50 @@
+"""Fixture jit-hygiene sites: trigger, suppression, clean counterpart."""
+
+from functools import partial
+
+import jax
+
+from .introspect import observe_jit
+
+
+def _kernel(x):
+    return x
+
+
+run_kernel = jax.jit(_kernel)  # expect: jit-unwrapped
+
+silenced_kernel = jax.jit(_kernel)  # verifylint: disable=jit-unwrapped
+
+wrapped_kernel = jax.jit(_kernel)
+wrapped_kernel = observe_jit("fixture.wrapped")(wrapped_kernel)
+
+
+@jax.jit
+def decorated(x, n):  # expect: jit-unwrapped
+    if n:  # expect: jit-traced-branch
+        return x + 1
+    return x
+
+
+@observe_jit("fixture.select")
+@partial(jax.jit, static_argnames=("mode",))
+def select(x, mode):
+    if mode:  # clean: static parameter, not traced
+        return x * 2
+    if x.shape[0] > 2:  # clean: shape reads are static
+        return x
+    return x
+
+
+def loops():
+    fns = []
+    for _i in range(3):
+        fns.append(jax.jit(_kernel))  # expect: jit-in-loop
+    return fns
+
+
+def bad_static():
+    return jax.jit(
+        _kernel,
+        static_argnums=[0],  # expect: jit-unhashable-static
+    )

@@ -1,0 +1,27 @@
+"""Fixture metrics-cardinality sites: naming and label closedness."""
+
+VERDICT_LABELS = {"ok": "pass", "bad": "fail"}
+
+
+def register(reg):
+    good_counter = reg.counter("verifyd_jobs_total")
+    good_gauge = reg.gauge("verifyd_queue_depth")
+    good_hist = reg.histogram("verifyd_wall_seconds")
+    bad_prefix = reg.counter("jobs_total")  # expect: metric-name
+    bad_counter = reg.counter("verifyd_jobs")  # expect: metric-name
+    bad_hist = reg.histogram("verifyd_wall")  # expect: metric-name
+    return good_counter, good_gauge, good_hist, bad_prefix, bad_counter, bad_hist
+
+
+def record(m, fingerprint):
+    m.inc(backend="native")  # clean: literal
+    m.inc(backend=fingerprint)  # expect: metric-open-label
+    m.inc(shard=fingerprint)  # verifylint: disable=metric-open-label
+    backend = str(fingerprint)
+    if backend not in ("native", "oracle"):
+        backend = "other"
+    m.inc(backend=backend)  # clean: validated enum fold
+    for writer in ("flight", "archive"):
+        m.inc(writer=writer)  # clean: loop over literal tuple
+    m.inc(verdict=VERDICT_LABELS.get(fingerprint, "other"))  # clean: dict fold
+    m.observe(0.5, exemplar=fingerprint)  # clean: exemplars are exempt

@@ -1,0 +1,18 @@
+"""Fixture wire table: _frame_mac disagrees with UNSIGNED_FIELDS."""  # expect: protocol-unsigned-mismatch
+
+FRAME_FIELDS = {
+    "ping": {},
+    "submit": {
+        "history": "required",
+        "client": "optional",
+        "deadline": "optional",
+    },
+}
+UNSIGNED_FIELDS = ("auth",)
+
+
+def _frame_mac(obj):
+    # Excludes "mac", but UNSIGNED_FIELDS declares "auth": fields silently
+    # escape (or double-enter) the authenticated region.
+    body = {k: v for k, v in obj.items() if k != "mac"}
+    return repr(sorted(body.items()))

@@ -1,0 +1,63 @@
+"""Fixture concurrency sites: racy write, locked/atomic clean shapes."""
+
+import threading
+
+
+class Racy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            self.count += 1  # expect: concurrency-unlocked-write
+
+    def snapshot(self):
+        return self.count
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        with self._lock:
+            self.count += 1  # clean: written under the instance lock
+
+    def snapshot(self):
+        with self._lock:
+            return self.count
+
+
+class Silenced:
+    def __init__(self):
+        self.flag = 0
+
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        self.flag += 1  # verifylint: disable=concurrency-unlocked-write
+
+    def read(self):
+        return self.flag
+
+
+class Publisher:
+    def __init__(self):
+        self._stop = None
+
+    def start(self):
+        self._stop = threading.Event()  # clean: one-shot atomic publication
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            pass

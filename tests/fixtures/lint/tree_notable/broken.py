@@ -1,0 +1,3 @@
+# expect-file: parse-error
+def broken(:
+    return None

@@ -1,0 +1,7 @@
+"""Fixture protocol.py with the wire table missing on purpose."""  # expect: protocol-no-table
+
+OPS = ("ping", "submit")
+
+
+def encode_frame(obj):
+    return repr(obj)

@@ -1,0 +1,374 @@
+"""verifylint: fixture corpus, suppressions, baseline ratchet, whole-tree
+smoke, and regression tests for the defects the first full run surfaced.
+
+The fixture mini-trees under ``tests/fixtures/lint/`` carry
+``# expect: <rule>`` annotations on the exact lines each rule must anchor
+to; ``test_fixture_corpus_exact`` holds the suite to them bidirectionally
+(every expectation fires, nothing else does).  ``scripts/lint_check.py``
+runs the same contract as a standalone gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+import pytest
+
+from s2_verification_tpu.analysis import (
+    ERROR,
+    Finding,
+    LintEngine,
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from s2_verification_tpu.analysis.engine import (
+    TreeContext,
+    discover_files,
+    scan_suppressions,
+)
+from s2_verification_tpu.analysis.event_schema import render_events_md
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "lint")
+
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([\w\-, ]+?)\s*$")
+_EXPECT_FILE_RE = re.compile(r"#\s*expect-file:\s*([\w\-]+)")
+
+
+def _expectations(root: str):
+    exact, file_level = [], []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), root).replace(os.sep, "/")
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    m = _EXPECT_RE.search(line)
+                    if m:
+                        exact.extend((rel, i, r.strip()) for r in m.group(1).split(","))
+                        continue
+                    m = _EXPECT_FILE_RE.search(line)
+                    if m:
+                        file_level.append((rel, m.group(1)))
+    return exact, file_level
+
+
+@pytest.fixture(scope="module")
+def tree_result():
+    return LintEngine(os.path.join(FIXTURES, "tree")).run(paths=["."])
+
+
+@pytest.fixture(scope="module")
+def notable_result():
+    return LintEngine(os.path.join(FIXTURES, "tree_notable")).run(paths=["."])
+
+
+@pytest.fixture(scope="module")
+def real_tree_result():
+    return LintEngine(REPO).run()
+
+
+# --------------------------------------------------------------------------
+# fixture corpus
+
+
+ALL_RULES = sorted(
+    [
+        "jit-unwrapped",
+        "jit-in-loop",
+        "jit-unhashable-static",
+        "jit-traced-branch",
+        "metric-open-label",
+        "metric-name",
+        "concurrency-unlocked-write",
+        "event-never-emitted",
+        "event-field-unwritten",
+        "protocol-no-table",
+        "protocol-unknown-op",
+        "protocol-unknown-field",
+        "protocol-missing-required",
+        "protocol-unguarded-read",
+        "protocol-unsigned-mismatch",
+        "parse-error",
+    ]
+)
+
+
+@pytest.mark.parametrize("rule", ALL_RULES)
+def test_every_rule_fires_on_fixtures(rule, tree_result, notable_result):
+    fired = {f.rule for f in tree_result.findings} | {
+        f.rule for f in notable_result.findings
+    }
+    assert rule in fired
+
+
+@pytest.mark.parametrize("tree", ["tree", "tree_notable"])
+def test_fixture_corpus_exact(tree, tree_result, notable_result):
+    """Bidirectional: every annotation fires at its line, nothing else fires."""
+    res = tree_result if tree == "tree" else notable_result
+    root = os.path.join(FIXTURES, tree)
+    exact, file_level = _expectations(root)
+    got = [(f.path, f.line, f.rule) for f in res.findings]
+    unmatched = list(got)
+    missing = []
+    for e in exact:
+        if e in unmatched:
+            unmatched.remove(e)
+        else:
+            missing.append(e)
+    for rel, rule in file_level:
+        hit = next((g for g in unmatched if g[0] == rel and g[2] == rule), None)
+        if hit is not None:
+            unmatched.remove(hit)
+        else:
+            missing.append((rel, None, rule))
+    assert not missing, f"annotated findings that did not fire: {missing}"
+    assert not unmatched, f"findings with no annotation: {unmatched}"
+
+
+def test_fixture_suppressions_counted(tree_result):
+    # client.py, jit_rules.py, metric_rules.py, threads_rules.py: one each
+    assert tree_result.suppressed == 4
+
+
+def test_all_findings_are_errors(tree_result, notable_result):
+    for f in tree_result.findings + notable_result.findings:
+        assert f.severity == ERROR
+
+
+# --------------------------------------------------------------------------
+# suppression scanning
+
+
+def test_scan_suppressions_same_line_and_shield():
+    text = (
+        "x = 1  # verifylint: disable=metric-open-label\n"
+        "# verifylint: disable=jit-unwrapped,jit-in-loop\n"
+        "y = 2\n"
+        "# verifylint: disable-file=concurrency-unlocked-write\n"
+    )
+    per_line, file_level = scan_suppressions(text)
+    assert per_line[1] == {"metric-open-label"}
+    # a comment-only directive shields its own line AND the next
+    assert per_line[2] == {"jit-unwrapped", "jit-in-loop"}
+    assert per_line[3] == {"jit-unwrapped", "jit-in-loop"}
+    assert file_level == {"concurrency-unlocked-write"}
+
+
+def test_suppress_all(tmp_path):
+    f = tmp_path / "mod.py"
+    f.write_text(
+        "# verifylint: disable-file=all\n"
+        "import jax\n"
+        "bad = jax.jit(len)\n"
+    )
+    res = LintEngine(str(tmp_path)).run(rel_paths=["mod.py"])
+    assert res.findings == []
+    assert res.suppressed == 1
+
+
+# --------------------------------------------------------------------------
+# baseline ratchet
+
+
+def _finding(msg: str, line: int = 3) -> Finding:
+    return Finding("metric-open-label", ERROR, "pkg/mod.py", line, msg)
+
+
+def test_ratchet_new_error_fails_baselined_passes(tmp_path):
+    old = _finding("old debt")
+    new = _finding("fresh regression")
+    path = str(tmp_path / "baseline.json")
+    write_baseline([old], path)
+    ratchet = apply_baseline([old, new], load_baseline(path))
+    assert [f.message for f in ratchet.new_errors] == ["fresh regression"]
+    assert [f.message for f in ratchet.baselined] == ["old debt"]
+    assert ratchet.stale_keys == []
+
+
+def test_ratchet_fixed_finding_goes_stale(tmp_path):
+    old = _finding("old debt")
+    path = str(tmp_path / "baseline.json")
+    write_baseline([old], path)
+    ratchet = apply_baseline([], load_baseline(path))
+    assert ratchet.new_errors == []
+    assert ratchet.stale_keys == [old.key]
+
+
+def test_ratchet_keys_are_line_independent_but_count_bounded(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    write_baseline([_finding("dup", line=10)], path)
+    moved = _finding("dup", line=99)  # same key, shuffled line: still covered
+    ratchet = apply_baseline([moved], load_baseline(path))
+    assert ratchet.new_errors == []
+    # a second occurrence of the same key exceeds the baselined count
+    ratchet = apply_baseline([moved, _finding("dup", line=100)], load_baseline(path))
+    assert len(ratchet.new_errors) == 1
+
+
+def test_write_baseline_preserves_justifications(tmp_path):
+    path = str(tmp_path / "baseline.json")
+    f = _finding("kept debt")
+    write_baseline([f], path, {f.key: "operator-bounded label"})
+    write_baseline([f], path)  # rewrite without passing justifications
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["findings"][0]["justification"] == "operator-bounded label"
+
+
+# --------------------------------------------------------------------------
+# caching + partial scans
+
+
+def test_cache_round_trip(tmp_path, tree_result):
+    cache = str(tmp_path / "cache.json")
+    root = os.path.join(FIXTURES, "tree")
+    first = LintEngine(root, cache_path=cache).run(paths=["."])
+    second = LintEngine(root, cache_path=cache).run(paths=["."])
+    assert first.cache_hits == 0
+    assert second.cache_hits > 0
+    assert [f.to_dict() for f in first.findings] == [
+        f.to_dict() for f in second.findings
+    ]
+    assert [f.to_dict() for f in first.findings] == [
+        f.to_dict() for f in tree_result.findings
+    ]
+
+
+def test_partial_scan_keeps_tree_context():
+    """A scoped run (lint --changed) still parses the whole package, so
+    tree passes don't report consumers of elsewhere-emitted events."""
+    res = LintEngine(REPO).run(rel_paths=["s2_verification_tpu/service/stats.py"])
+    assert not [
+        f
+        for f in res.findings
+        if f.rule in ("event-never-emitted", "event-field-unwritten")
+    ]
+    for f in res.findings:
+        assert f.path == "s2_verification_tpu/service/stats.py"
+
+
+# --------------------------------------------------------------------------
+# whole-tree smoke + docs
+
+
+def test_real_tree_no_new_errors(real_tree_result):
+    baseline = load_baseline(os.path.join(REPO, ".verifylint-baseline.json"))
+    ratchet = apply_baseline(real_tree_result.errors, baseline)
+    assert not ratchet.new_errors, [f.key for f in ratchet.new_errors]
+    assert not ratchet.stale_keys
+
+
+def test_events_md_up_to_date():
+    ctx = TreeContext(REPO, discover_files(REPO))
+    with open(os.path.join(REPO, "docs", "EVENTS.md"), encoding="utf-8") as f:
+        assert f.read() == render_events_md(ctx)
+
+
+# --------------------------------------------------------------------------
+# regression tests for the findings fixed in-tree
+
+
+def test_prober_transition_fires_once_under_contention():
+    """probe_once is both the poller tick and a public entry; the status
+    read-modify-write is locked so a transition fires on_change once."""
+    from s2_verification_tpu.obs.probe import HealthProber
+
+    fired = []
+    fired_lock = threading.Lock()
+
+    def on_change(name, up):
+        with fired_lock:
+            fired.append((name, up))
+
+    prober = HealthProber({"b0": lambda: True}, on_change=on_change)
+    n = 8
+    barrier = threading.Barrier(n)
+
+    def hammer():
+        barrier.wait()
+        prober.probe_once()
+
+    threads = [threading.Thread(target=hammer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # first observation is one transition (None -> up), seen exactly once
+    assert fired == [("b0", True)]
+
+
+def test_prober_transition_sequence():
+    from s2_verification_tpu.obs.probe import HealthProber
+
+    state = {"up": True}
+    fired = []
+    prober = HealthProber(
+        {"b0": lambda: state["up"]}, on_change=lambda n, up: fired.append(up)
+    )
+    prober.probe_once()
+    prober.probe_once()  # steady: no edge
+    state["up"] = False
+    prober.probe_once()
+    state["up"] = True
+    prober.probe_once()
+    assert fired == [True, False, True]
+    assert prober.status == {"b0": True}
+
+
+def test_dashboard_throughput_deltas_locked():
+    """sample_once's prev_* baseline is read-then-write under the lock;
+    sequential ticks must diff against the moving baseline exactly once."""
+    from s2_verification_tpu.obs.dashboard import Dashboard
+    from s2_verification_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    completed = reg.counter("verifyd_jobs_completed_total", "test")
+    times = iter([0.0, 1.0, 2.0, 4.0])
+    dash = Dashboard(reg, time_fn=lambda: next(times))
+    assert dash.sample_once()["throughput"] == 0.0  # no baseline yet
+    completed.inc(5)
+    assert dash.sample_once()["throughput"] == 5.0  # 5 jobs / 1 s
+    assert dash.sample_once()["throughput"] == 0.0  # baseline advanced
+    completed.inc(4)
+    assert dash.sample_once()["throughput"] == 2.0  # 4 jobs / 2 s
+    assert len(dash.payload()["t"]) == 4
+
+
+def test_stats_backend_label_folded():
+    """Sized backend values must fold to the engine family before they
+    become a label — no timeseries per mesh size / device ordinal."""
+    from s2_verification_tpu.obs.metrics import MetricsRegistry
+    from s2_verification_tpu.service.stats import ServiceStats
+
+    reg = MetricsRegistry()
+    stats = ServiceStats(sink=None, registry=reg)
+    for backend in ("device-mesh[4]", "device-mesh[8]", "device-3", "native", "zzz-custom"):
+        stats.emit("done", verdict=0, wall_s=0.1, backend=backend)
+    wall = reg.get("verifyd_wall_seconds")
+    assert wall.counts(backend="device-mesh")[2] == 2
+    assert wall.counts(backend="device")[2] == 1
+    assert wall.counts(backend="native")[2] == 1
+    assert wall.counts(backend="other")[2] == 1
+    assert wall.counts(backend="device-mesh[4]")[2] == 0
+
+
+def test_stats_writer_label_folded():
+    from s2_verification_tpu.obs.metrics import MetricsRegistry
+    from s2_verification_tpu.service.stats import ServiceStats
+
+    reg = MetricsRegistry()
+    stats = ServiceStats(sink=None, registry=reg)
+    stats.emit("writer_degraded", writer="surprise-writer-17")
+    g = reg.get("verifyd_writer_degraded")
+    assert g.value(writer="other") == 1
+    stats.emit("writer_recovered", writer="surprise-writer-17")
+    assert g.value(writer="other") == 0
+    stats.emit("writer_degraded", writer="journal")
+    assert g.value(writer="journal") == 1
