@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak perfgate lint clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak batch perfgate lint clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: lint obs mesh fleet overload soak
+chaos-full: lint obs mesh fleet overload soak batch
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -87,6 +87,14 @@ soak:
 lint:
 	JAX_PLATFORMS=cpu $(PYTHON) -m s2_verification_tpu.cli lint
 	JAX_PLATFORMS=cpu $(PYTHON) -m s2_verification_tpu.cli lint --check-events-md
+
+# Continuous-batching gate (scripts/batch_check.py): a live --batching
+# daemon under mixed-shape concurrent load — verdict parity with
+# one-shot check on every reply, zero lost jobs, throughput over the
+# published single-daemon baseline, multi-lane batch_launch events with
+# per-job done attribution intact.
+batch: native
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/batch_check.py
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

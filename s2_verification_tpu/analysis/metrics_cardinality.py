@@ -31,7 +31,8 @@ Rules
 ``metric-name`` (error)
     Registered metric families must follow the exposition conventions:
     names start ``verifyd_``; counters end ``_total``; histograms end in a
-    unit suffix (``_seconds``/``_bytes``/``_layers``/``_ratio``/``_ops``).
+    unit suffix (``_seconds``/``_bytes``/``_layers``/``_ratio``/``_ops``/
+    ``_lanes``).
 """
 
 from __future__ import annotations
@@ -52,7 +53,7 @@ from .engine import (
 
 _METRIC_METHODS = {"inc", "set", "observe"}
 _REG_METHODS = {"counter": "_total", "gauge": None, "histogram": "UNIT"}
-_HIST_SUFFIXES = ("_seconds", "_bytes", "_layers", "_ratio", "_ops")
+_HIST_SUFFIXES = ("_seconds", "_bytes", "_layers", "_ratio", "_ops", "_lanes")
 _RECEIVER_RE = re.compile(r"(^|_)(m|g|h|metric|counter|gauge|hist(ogram)?)(_|$)", re.I)
 
 

@@ -1,0 +1,233 @@
+"""Cross-job batched checking: one launch decides many same-shape lanes.
+
+Two lane engines behind one result shape:
+
+- **batch-native** — a Python loop over the native C engine with
+  *pre-encoded* lanes (``check_native(..., enc=...)``).  The ctypes
+  boundary is ~10µs/call and the C search releases the GIL, so on a CPU
+  node the per-job win comes from encoding the whole launch group once
+  (:func:`..models.encode.encode_batch`) and skipping every per-job
+  dispatch layer between verdicts.  Every lane gets the canonical rich
+  ``CheckResult`` (witness, refusals, deepest), so this engine is
+  drop-in for any job the sequential path could serve.
+
+- **batch-vmap** — the whole launch group runs as ONE compiled
+  ``jax.vmap`` of :func:`..checker.device.run_search` over a lane axis.
+  ``encode_batch`` makes every lane's arrays shape-identical, per-lane
+  ``SearchTables``/``Frontier`` pytrees are stacked on a leading axis,
+  and JAX's batched ``while_loop`` gives the continuous-batching lane
+  semantics for free: a lane whose search stops (accept/empty) has its
+  carry **latched** — the batch keeps stepping for the stragglers, the
+  decided lane's result is frozen, and ``RunOut.layers`` records how
+  early it decided (the early-exit signal the metrics report).  Beam
+  soundness is per lane: OK is conclusive under pruning, EMPTY is
+  ILLEGAL only if that lane never pruned; anything else returns ``None``
+  and the caller escalates that lane on the sequential path.  No witness
+  is recovered (viz-requesting jobs belong on the sequential path).
+
+Launch sizes are bucketed to powers of two (short lanes are padded by
+repeating the last real lane and discarding the copies' results) so the
+compile-variant count stays bounded exactly like every other shape axis.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.encode import EncodedHistory, round_pow2
+from .device import (
+    STOP_ACCEPT,
+    STOP_EMPTY,
+    Frontier,
+    build_tables,
+    init_frontier,
+    run_search,
+)
+from .entries import History
+from .native import check_native, native_available
+from .oracle import CheckOutcome, CheckResult
+
+__all__ = [
+    "BatchLane",
+    "LaneVerdict",
+    "check_batch_native",
+    "check_batch_vmap",
+    "default_engine",
+]
+
+#: Beam capacity per vmap lane.  Collector-shaped histories decide at
+#: tiny frontiers (the sequential driver *starts* at 16); per-layer fold
+#: cost scales with this width for every lane, so the lane default stays
+#: small and a pruned dead end escalates that one lane to the sequential
+#: path instead of paying 4096-wide layers for everyone.
+VMAP_LANE_CAPACITY = 64
+
+
+@dataclass
+class BatchLane:
+    """One job's search inputs inside a launch group."""
+
+    history: History
+    enc: EncodedHistory
+    time_budget_s: float | None = None
+
+
+@dataclass
+class LaneVerdict:
+    """Per-lane outcome of a batched launch.
+
+    ``result`` is ``None`` when this engine could not decide the lane
+    (vmap lane pruned into a dead end, or the lane was skipped) — the
+    caller runs that lane through the sequential portfolio instead.
+    ``search_s`` is the per-lane attributed search wall: the lane's own
+    C call for batch-native, the shared kernel wall for batch-vmap.
+    ``layers`` (vmap only) is how many layers the lane ran before its
+    verdict latched — lanes with ``layers`` below the launch maximum
+    decided early while the batch kept stepping.
+    """
+
+    result: CheckResult | None
+    engine: str
+    search_s: float
+    layers: int = -1
+    skipped: str | None = None
+
+
+def default_engine() -> str:
+    """'native' when the C engine is loadable, else 'vmap'."""
+    return "native" if native_available() else "vmap"
+
+
+def check_batch_native(
+    lanes: list[BatchLane],
+    skip=None,
+    profile: bool = False,
+    on_lane=None,
+) -> list[LaneVerdict]:
+    """Run each lane through the native engine without re-encoding.
+
+    ``skip(i)`` is consulted immediately before lane *i* dispatches and
+    returns a reason string to skip it (cancelled / deadline passed) or
+    ``None`` to run it — the late-cancel boundary between lanes that the
+    sequential path gets from its per-job cancel checks.
+
+    ``on_lane(i, verdict)`` fires the moment lane *i* decides, while
+    later lanes are still searching — the early-exit hook the batcher
+    uses to answer clients lane by lane.
+    """
+    out: list[LaneVerdict] = []
+    for i, lane in enumerate(lanes):
+        reason = skip(i) if skip is not None else None
+        if reason is not None:
+            v = LaneVerdict(None, "batch-native", 0.0, skipped=reason)
+        else:
+            t0 = time.monotonic()
+            res = check_native(
+                lane.history,
+                time_budget_s=lane.time_budget_s,
+                profile=profile,
+                enc=lane.enc,
+            )
+            v = LaneVerdict(res, "batch-native", time.monotonic() - t0)
+        out.append(v)
+        if on_lane is not None:
+            on_lane(i, v)
+    return out
+
+
+def _stack(trees):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _mega_launch(tables, frontier, max_layers):
+    """jit(vmap(run_search)) — compiled once per (lane dims, B) bucket."""
+    import jax
+
+    fn = jax.vmap(
+        lambda t, f, ml: run_search(t, f, ml, allow_prune=True),
+        in_axes=(0, 0, None),
+    )
+    return fn(tables, frontier, max_layers)
+
+
+def check_batch_vmap(
+    lanes: list[BatchLane],
+    skip=None,
+    capacity: int = VMAP_LANE_CAPACITY,
+) -> list[LaneVerdict]:
+    """One vmapped frontier search over the whole launch group.
+
+    Lanes must come from one :func:`..models.encode.encode_batch` call
+    (shape-identical arrays).  Per-lane verdicts follow the beam
+    soundness rules; undecidable lanes return ``result=None``.
+    """
+    n = len(lanes)
+    verdicts: list[LaneVerdict | None] = [None] * n
+    live: list[int] = []  # lane indices that actually launch
+    tables_list = []
+    frontier_list = []
+    for i, lane in enumerate(lanes):
+        reason = skip(i) if skip is not None else None
+        if reason is not None:
+            verdicts[i] = LaneVerdict(None, "batch-vmap", 0.0, skipped=reason)
+            continue
+        enc = lane.enc
+        if enc.total_remaining == 0:
+            # Forced prefix consumed every op: trivially OK (same early
+            # return as the sequential drivers).
+            verdicts[i] = LaneVerdict(
+                CheckResult(
+                    CheckOutcome.OK,
+                    linearization=list(enc.forced_prefix),
+                    final_states=sorted(enc.init_states),
+                ),
+                "batch-vmap",
+                0.0,
+                layers=0,
+            )
+            continue
+        try:
+            frontier_list.append(init_frontier(enc, capacity))
+        except ValueError:
+            # More initial states than lane capacity: sequential path.
+            verdicts[i] = LaneVerdict(
+                None, "batch-vmap", 0.0, skipped="init-overflow"
+            )
+            continue
+        tables_list.append(build_tables(enc))
+        live.append(i)
+
+    if not live:
+        return verdicts  # type: ignore[return-value]  (all entries set)
+
+    # Pad the launch to a power-of-two lane count so compile variants stay
+    # bounded; pad lanes repeat the last real lane and are discarded.
+    b = round_pow2(len(live), 1)
+    while len(tables_list) < b:
+        tables_list.append(tables_list[-1])
+        frontier_list.append(frontier_list[-1])
+
+    max_layers = max(lanes[i].enc.total_remaining for i in live) + 2
+    t0 = time.monotonic()
+    out = _mega_launch(_stack(tables_list), _stack(frontier_list), max_layers)
+    stop = np.asarray(out.stop_code)
+    pruned = np.asarray(out.pruned_ever)
+    layers = np.asarray(out.layers)
+    wall = time.monotonic() - t0
+
+    for k, i in enumerate(live):
+        code, lane_layers = int(stop[k]), int(layers[k])
+        if code == STOP_ACCEPT:
+            res: CheckResult | None = CheckResult(CheckOutcome.OK)
+        elif code == STOP_EMPTY and not bool(pruned[k]):
+            res = CheckResult(CheckOutcome.ILLEGAL)
+        else:
+            res = None  # pruned dead end / layer cap: escalate this lane
+        verdicts[i] = LaneVerdict(res, "batch-vmap", wall, layers=lane_layers)
+    return verdicts  # type: ignore[return-value]

@@ -22,7 +22,7 @@ import time as _time
 
 import numpy as np
 
-from ..models.encode import encode_history, intern_state
+from ..models.encode import EncodedHistory, encode_history, intern_state
 from ..models.stream import StreamState
 from .entries import History
 from .oracle import CheckOutcome, CheckResult
@@ -106,6 +106,7 @@ def check_native(
     time_budget_s: float | None = None,
     _states_cap: int = 4096,
     profile: bool = False,
+    enc: EncodedHistory | None = None,
 ) -> CheckResult:
     """Decide linearizability with the native engine.
 
@@ -118,10 +119,16 @@ def check_native(
     ``res.profile`` — ``{"encode_s", "search_s", "steps", "cache_hits"}``
     (the native search has no BFS layers; DFS steps and memo hits are its
     shape signal).  ``search_s`` accumulates the rare overflow re-invoke.
+
+    ``enc`` lets callers that already encoded ``history`` (the batched
+    lane runner encodes a whole launch group up front) skip the second
+    encode; it must be ``encode_history(history)`` output for the same
+    history.
     """
     lib = _load()
     t_enc0 = _time.monotonic() if profile else 0.0
-    enc = encode_history(history)
+    if enc is None:
+        enc = encode_history(history)
     encode_s = (_time.monotonic() - t_enc0) if profile else 0.0
 
     def _attach(res: CheckResult, search_s: float) -> CheckResult:

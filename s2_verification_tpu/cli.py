@@ -634,6 +634,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         max_rss_frac=args.max_rss_frac,
         deadline_grace_s=args.deadline_grace,
         quarantine_threshold=args.quarantine_threshold,
+        fast_admission=args.fast_admission,
+        batching=args.batching,
+        batch_engine=args.batch_engine,
     )
     daemon = Verifyd(cfg)
 
@@ -1962,6 +1965,31 @@ def build_parser() -> argparse.ArgumentParser:
         "across this many process deaths or supervised-child kills is "
         "quarantined (definite Quarantined error) instead of replayed; "
         "needs --state-dir (default 3)",
+    )
+    s.add_argument(
+        "--batching",
+        action="store_true",
+        help="continuous cross-job batching: drain every queued job of a "
+        "worker-picked shape group into one mega-launch (late-join at "
+        "launch boundaries, per-lane deadlines/cancels honored, per-job "
+        "done attribution)",
+    )
+    s.add_argument(
+        "--batch-engine",
+        default="auto",
+        choices=("auto", "native", "vmap"),
+        help="mega-launch engine: native (pre-encoded C lanes, per-lane "
+        "early exit) or vmap (one compiled vmapped frontier search per "
+        "launch); auto picks native when the C engine is built "
+        "(default auto)",
+    )
+    s.add_argument(
+        "--no-fast-admission",
+        dest="fast_admission",
+        action="store_false",
+        default=True,
+        help="disable the fused single-pass admission parser and decode "
+        "every submission through the layered event decoder",
     )
     s.set_defaults(fn=_cmd_serve, stats=False)
 

@@ -30,10 +30,11 @@ from __future__ import annotations
 
 import json
 import logging
+import struct
 import threading
 from collections import OrderedDict
 
-from ..checker.entries import History
+from ..checker.entries import History, Op
 from ..utils.hashing import chain_hash, record_hash
 from ..utils.seglog import Recovery, SegmentLog
 
@@ -41,7 +42,55 @@ __all__ = ["history_fingerprint", "VerdictCache"]
 
 log = logging.getLogger("s2_verification_tpu.verifyd")
 
-_FP_VERSION = "v1"
+#: v1 folded f-string reprs of the op dataclasses (~0.4 ms per
+#: collector-sized history — measurable at batched-admission rates); v2
+#: packs the same fields with ``struct`` for an ~8x cheaper canon.  The
+#: version prefix keys persisted verdict segments, so bumping it simply
+#: cold-starts the durable cache — no migration, no wrong answers.
+_FP_VERSION = "v2"
+
+#: Fixed-width op head: client_id, call, ret, flags, input_type,
+#: match_seq_num, num_records, tail, stream_hash, len(record_hashes).
+#: Optional ints encode as 0 with a presence bit in ``flags`` so 0 and
+#: absent stay distinct.
+_OP_HEAD = struct.Struct("<QqqBBqqqQI")
+
+
+def _op_canon(op: Op) -> bytes:
+    inp, out = op.inp, op.out
+    flags = (
+        (1 if op.pending else 0)
+        | (2 if out.failure else 0)
+        | (4 if out.definite_failure else 0)
+        | (8 if inp.match_seq_num is not None else 0)
+        | (16 if inp.num_records is not None else 0)
+        | (32 if out.tail is not None else 0)
+        | (64 if out.stream_hash is not None else 0)
+    )
+    head = _OP_HEAD.pack(
+        op.client_id,
+        op.call,
+        op.ret,
+        flags,
+        inp.input_type,
+        inp.match_seq_num or 0,
+        inp.num_records or 0,
+        out.tail or 0,
+        out.stream_hash or 0,
+        len(inp.record_hashes),
+    )
+    if inp.record_hashes:
+        hashes = struct.pack(f"<{len(inp.record_hashes)}Q", *inp.record_hashes)
+    else:
+        hashes = b""
+    toks = []
+    for tok in (inp.set_fencing_token, inp.batch_fencing_token):
+        if tok is None:
+            toks.append(b"\xff")  # distinct from any length prefix (b"\x00")
+        else:
+            tb = tok.encode("utf-8")
+            toks.append(b"\x00" + struct.pack("<I", len(tb)) + tb)
+    return b"".join((head, hashes, *toks))
 
 
 def history_fingerprint(hist: History) -> str:
@@ -51,17 +100,23 @@ def history_fingerprint(hist: History) -> str:
     real-time window, input, output, pending-completion flag) through
     ``chain_hash`` in op order — the same left-fold discipline as the
     stream-hash protocol.  Everything the verdict depends on is covered:
-    op semantics via ``inp``/``out`` (dataclass reprs are deterministic),
-    real-time order via ``call``/``ret``, chain structure via
-    ``client_id``.
+    op semantics via ``inp``/``out``, real-time order via ``call``/``ret``,
+    chain structure via ``client_id``.  The encoding is injective: the op
+    head is fixed-width, the record-hash block's length is in the head,
+    and fencing tokens are length-prefixed with a distinct None marker.
     """
     acc = 0
     for op in hist.ops:
-        canon = (
-            f"{op.client_id}|{op.call}|{op.ret}|{op.pending}|"
-            f"{op.inp!r}|{op.out!r}"
-        )
-        acc = chain_hash(acc, record_hash(canon.encode("utf-8")))
+        try:
+            canon = _op_canon(op)
+        except struct.error:
+            # client_id past u64 or a similarly absurd-but-decodable value:
+            # fall back to the deterministic repr canon for this op.
+            canon = (
+                f"{op.client_id}|{op.call}|{op.ret}|{op.pending}|"
+                f"{op.inp!r}|{op.out!r}"
+            ).encode("utf-8")
+        acc = chain_hash(acc, record_hash(canon))
     return f"{_FP_VERSION}:{acc:016x}:{len(hist.ops)}"
 
 

@@ -305,8 +305,9 @@ class VerifydClient:
 
     def submit(
         self,
-        history_text: str,
+        history_text: str | None = None,
         *,
+        records: list | None = None,
         client: str = "client",
         priority: int = 10,
         no_viz: bool | None = None,
@@ -324,15 +325,25 @@ class VerifydClient:
         ``deadline_s`` rides the frame as the end-to-end ``deadline``
         field: the daemon refuses admissions it cannot meet and cancels
         the search when the budget runs out mid-flight (definite
-        ``DeadlineExceeded``).  Old daemons ignore the field."""
+        ``DeadlineExceeded``).  Old daemons ignore the field.
+
+        ``records`` submits the history as an already-decoded list of
+        event objects instead of a JSONL string — one less
+        serialize/parse round-trip on the hot path.  Exactly one of
+        ``history_text`` / ``records`` must be given."""
+        if (history_text is None) == (records is None):
+            raise ValueError("submit takes exactly one of history_text / records")
         tid = trace_id or new_trace_id()
         req: dict = {
             "op": "submit",
-            "history": history_text,
             "client": client,
             "priority": priority,
             TRACE_FIELD: trace_frame(tid),
         }
+        if records is not None:
+            req["records"] = records
+        else:
+            req["history"] = history_text
         if no_viz is not None:
             req["no_viz"] = no_viz
         if deadline_s is not None:

@@ -28,6 +28,7 @@ import asyncio
 import contextlib
 import functools
 import itertools
+import json
 import logging
 import os
 import sys
@@ -51,6 +52,7 @@ from ..obs.sentinel import PerfSentinel, SentinelConfig
 from ..obs.trace import Tracer
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
+from .fastprep import FastPrepFallback, fast_prepare
 from .journal import JobJournal
 from .overload import (
     AdmissionController,
@@ -191,6 +193,15 @@ class VerifydConfig:
     deadline_grace_s: float = 2.0
     #: process deaths / child kills per fingerprint before quarantine
     quarantine_threshold: int = 3
+    #: fused single-pass admission (service/fastprep.py): parse, pair,
+    #: validate and prepare in one walk, falling back to the layered
+    #: decode path on any anomaly (identical errors, just slower)
+    fast_admission: bool = True
+    #: continuous cross-job batching: shape groups run as mega-launches
+    #: (service/batcher.py) with late-join and per-lane attribution
+    batching: bool = False
+    #: lane engine for mega-launches: auto | native | vmap
+    batch_engine: str = "auto"
     extra: dict = field(default_factory=dict)
 
 
@@ -394,6 +405,8 @@ class Verifyd:
             journal_writer=self._journal_writer,
             quarantine=self.quarantine,
             cancel_grace_s=config.deadline_grace_s,
+            batching=config.batching,
+            batch_engine=config.batch_engine,
         )
         self._job_ids = itertools.count(1)
         #: submits between dispatch and reply-written (loop thread owns
@@ -936,7 +949,20 @@ class Verifyd:
         if trace_id is None:
             trace_id = new_trace_id()
         text = req.get("history")
-        if not isinstance(text, str) or not text.strip():
+        records = req.get("records")
+        if records is not None:
+            # Structured submission: the client ships the event records as
+            # a JSON array instead of a JSONL string, skipping one
+            # serialize/parse round-trip on the hot path.  The journal and
+            # corpus archive still get canonical JSONL (``wire_text``).
+            if not isinstance(records, list) or not records:
+                self.stats.emit("decode_error", reason="bad records")
+                return err(
+                    ERR_DECODE, "'records' must be a non-empty list of event objects"
+                )
+            if text is not None:
+                return err(ERR_DECODE, "send 'history' or 'records', not both")
+        elif not isinstance(text, str) or not text.strip():
             self.stats.emit("decode_error", reason="missing history")
             return err(ERR_DECODE, "submit needs a non-empty 'history' JSONL string")
         client = str(req.get("client") or "anon")
@@ -958,12 +984,40 @@ class Verifyd:
                 )
 
         t_prep0 = self.tracer.now()
-        try:
-            events = list(ev.iter_history(text))
-            hist = prepare(events, elide_trivial=True)
-        except (ev.DecodeError, ValueError) as e:
-            self.stats.emit("decode_error", client=client, reason=str(e)[:200])
-            return err(ERR_DECODE, str(e))
+        # Fast admission: one fused parse+pair+validate+build pass
+        # (service/fastprep.py).  Fallback-not-fork: anything the fast
+        # path won't vouch for re-runs through the layered decoder below,
+        # which produces the canonical error message for every rejection.
+        prep = None
+        if self.cfg.fast_admission:
+            try:
+                prep = fast_prepare(text=text, records=records)
+            except FastPrepFallback:
+                prep = None
+        if prep is not None:
+            events = prep.events
+            hist = prep.hist
+            if text is None:
+                text = prep.wire_text()
+        else:
+            if text is None:
+                try:
+                    text = "\n".join(
+                        json.dumps(r, separators=(",", ":")) for r in records
+                    )
+                except (TypeError, ValueError) as e:
+                    self.stats.emit(
+                        "decode_error", client=client, reason=str(e)[:200]
+                    )
+                    return err(
+                        ERR_DECODE, f"'records' are not JSON-serializable: {e}"
+                    )
+            try:
+                events = list(ev.iter_history(text))
+                hist = prepare(events, elide_trivial=True)
+            except (ev.DecodeError, ValueError) as e:
+                self.stats.emit("decode_error", client=client, reason=str(e)[:200])
+                return err(ERR_DECODE, str(e))
         t_prep1 = self.tracer.now()
 
         fingerprint = history_fingerprint(hist)

@@ -189,7 +189,11 @@ FRAME_FIELDS = {
     "trace": {},
     "fleet": {},
     "submit": {
-        "history": "required",
+        # Exactly one of history (JSONL string) / records (JSON array of
+        # event objects) — the daemon enforces the one-of; both are
+        # optional at the frame layer so either wire form interoperates.
+        "history": "optional",
+        "records": "optional",
         "client": "optional",
         "priority": "optional",
         "no_viz": "optional",

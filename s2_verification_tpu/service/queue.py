@@ -125,6 +125,27 @@ class AdmissionQueue:
                 self._heap = rest
             return batch
 
+    def drain_shape(self, shape: str, batch_max: int = 16) -> list[Job]:
+        """Pop up to ``batch_max`` queued jobs of ``shape``, priority
+        order, without blocking — the late-join drain: a worker that just
+        finished a mega-launch offers the next launch to jobs of the same
+        shape that arrived while it was in flight.  Returns ``[]`` when
+        none are queued."""
+        with self._lock:
+            if not self._heap:
+                return []
+            batch: list[Job] = []
+            rest: list[tuple[int, int, Job]] = []
+            for entry in sorted(self._heap):
+                if len(batch) < batch_max and entry[2].shape == shape:
+                    batch.append(entry[2])
+                else:
+                    rest.append(entry)
+            if batch:
+                heapq.heapify(rest)
+                self._heap = rest
+            return batch
+
     def close(self) -> None:
         """Stop admissions and wake blocked workers (they drain what's
         left, then see ``[]``)."""

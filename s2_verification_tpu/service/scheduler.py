@@ -117,9 +117,13 @@ class Scheduler:
         journal_writer=None,
         quarantine=None,
         cancel_grace_s: float = 2.0,
+        batching: bool = False,
+        batch_engine: str = "auto",
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
+        if batch_engine not in ("auto", "native", "vmap"):
+            raise ValueError(f"unknown batch engine {batch_engine!r}")
         self.queue = queue
         self.cache = cache
         self.stats = stats
@@ -151,6 +155,15 @@ class Scheduler:
         self.quarantine = quarantine
         #: SIGTERM→SIGKILL grace for cancelled supervised children
         self.cancel_grace_s = cancel_grace_s
+        #: continuous cross-job batching: shape groups of >= 2 jobs run
+        #: as one mega-launch (service/batcher.py) instead of job by job
+        self.batching = batching
+        self.batch_engine = batch_engine
+        self._batcher = None
+        if batching:
+            from .batcher import Batcher
+
+            self._batcher = Batcher(self, engine=batch_engine)
         self._threads: list[threading.Thread] = []
         self._stopping = False
 
@@ -180,6 +193,11 @@ class Scheduler:
                     return
                 continue
             self.stats.set_queue_depth(len(self.queue))
+            if self._batcher is not None and len(batch) > 1:
+                # Mega-launch: the whole shape group (plus late-joiners)
+                # in one batched search; the batcher resolves every job.
+                self._batcher.run_group(batch)
+                continue
             for job in batch:
                 try:
                     reply = self._run_job(job)
@@ -247,8 +265,16 @@ class Scheduler:
             reason=reason,
         )
 
-    def _run_job(self, job: Job) -> dict:
-        t_pick = time.monotonic()
+    def _prestart(
+        self, job: Job, t_pick: float
+    ) -> tuple[dict | None, float, bool]:
+        """Everything between picking a job and starting its search.
+
+        Returns ``(reply, queue_wait, warm)``; a non-None ``reply`` means
+        the job was answered here (cancelled in the queue, or a verdict-
+        cache twin landed while it waited) and must not run.  Shared by
+        the sequential path and the batcher's per-lane prestart.
+        """
         queue_wait = t_pick - (job.enqueued_at or job.submitted_at)
         # Cancellation boundary #1: a job whose deadline passed in the
         # queue (or whose client hung up / whose daemon is stopping)
@@ -257,7 +283,11 @@ class Scheduler:
             job.cancel.cancel("shutdown")
         reason = job.cancel.check()
         if reason is not None:
-            return self._cancel_reply(job, reason, queue_wait, started=False)
+            return (
+                self._cancel_reply(job, reason, queue_wait, started=False),
+                queue_wait,
+                False,
+            )
         # Duplicate admitted while its twin was still in flight: answer
         # from the verdict cache at execution time too.
         cached = self.cache.get(job.fingerprint)
@@ -281,7 +311,7 @@ class Scheduler:
                 verdict=cached.get("verdict"),
                 outcome=str(cached.get("outcome", "cached")),
             )
-            return ok(cached)
+            return ok(cached), queue_wait, False
 
         # Run record before the search: it is what lets boot-time orphan
         # recovery distinguish a poison job (started, then the process
@@ -311,6 +341,13 @@ class Scheduler:
                 tid=job.id,
                 args={"trace_id": job.trace_id},
             )
+        return None, queue_wait, warm
+
+    def _run_job(self, job: Job) -> dict:
+        t_pick = time.monotonic()
+        reply, queue_wait, warm = self._prestart(job, t_pick)
+        if reply is not None:
+            return reply
         t0 = time.monotonic()
         # Job context for the JIT introspector: anything the portfolio
         # compiles (inline device escalation included) is attributed to
@@ -335,7 +372,24 @@ class Scheduler:
                 "trace_id": job.trace_id,
             },
         )
+        return self._finish(
+            job, res, backend, queue_wait=queue_wait, warm=warm, wall=wall
+        )
 
+    def _finish(
+        self,
+        job: Job,
+        res: CheckResult,
+        backend: str,
+        *,
+        queue_wait: float,
+        warm: bool,
+        wall: float,
+    ) -> dict:
+        """Turn a search result into the job's reply: cancel boundary #2,
+        artifact, verdict-cache put, journal done-mark, ``done`` event.
+        ``wall`` is this job's own search span — for batched lanes, its
+        queue-pick→decide time, not the mega-launch wall."""
         # Cancellation boundary #2: a search abandoned mid-flight comes
         # back UNKNOWN — answer the cancellation, not a fake verdict.  A
         # conclusive result that beat the cancel is still worth more to

@@ -32,7 +32,12 @@ fds / deadline), ``client_gone`` (a submit's TCP peer vanished
 mid-wait), ``job_quarantined`` / ``quarantine_release`` /
 ``quarantine_reject`` (poison-job ledger transitions), and
 ``writer_degraded`` / ``writer_recovered`` (a durable writer hit
-ENOSPC/OSError and dropped to memory-only / re-armed).
+ENOSPC/OSError and dropped to memory-only / re-armed).  Continuous
+batching (ISSUE 15): ``batch_launch`` — one mega-launch of a shape group
+(``engine`` = batch-native / batch-vmap, ``lanes``, ``decided``,
+``early_exits``, ``occupancy`` = lanes over ``batch_max``, ``late_join``,
+``wall_s`` = the launch wall; per-job attribution stays on each lane's
+own ``done`` event).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -64,6 +69,10 @@ if TYPE_CHECKING:  # pragma: no cover
 __all__ = ["ServiceStats"]
 
 _VERDICT_LABEL = {0: "ok", 1: "illegal", 2: "unknown"}
+
+#: Lanes-per-launch histogram buckets: powers of two up to the largest
+#: supported ``batch_max`` — launch sizes are pow2-bucketed anyway.
+_LANE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
 
 
 class ServiceStats:
@@ -127,6 +136,9 @@ class ServiceStats:
             "quarantine_rejects": 0,
             "writer_degraded_events": 0,
             "client_gone": 0,
+            "batch_launches": 0,
+            "batch_lanes": 0,
+            "batch_early_exits": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -295,6 +307,23 @@ class ServiceStats:
             "verifyd_writer_degraded",
             "1 while the named durable writer is degraded to memory-only",
             labelnames=("writer",),
+        )
+        # Continuous cross-job batching (ISSUE 15).  Engine label is the
+        # closed {batch-native, batch-vmap} set (anything else folds to
+        # "other"), so cardinality is bounded by construction.
+        self._m_batch_lanes = r.histogram(
+            "verifyd_batch_launch_lanes",
+            "Live lanes per mega-launch, by batch engine",
+            buckets=_LANE_BUCKETS,
+            labelnames=("engine",),
+        )
+        self._m_batch_early = r.counter(
+            "verifyd_batch_early_exits_total",
+            "Lanes whose verdict latched while other lanes kept searching",
+        )
+        self._m_batch_occupancy = r.gauge(
+            "verifyd_batch_launch_occupancy_ratio",
+            "Lanes over batch_max for the most recent mega-launch",
         )
         # Resource telemetry (obs/introspect.ResourceSampler sets these).
         self._m_res_rss = r.gauge(
@@ -509,6 +538,19 @@ class ServiceStats:
             self._m_writer_degraded.set(0, writer=writer)
         elif event == "client_gone":
             self._counters["client_gone"] += 1
+        elif event == "batch_launch":
+            lanes = int(fields.get("lanes", 0))
+            early = int(fields.get("early_exits", 0))
+            self._counters["batch_launches"] += 1
+            self._counters["batch_lanes"] += lanes
+            self._counters["batch_early_exits"] += early
+            engine = str(fields.get("engine", "other"))
+            if engine not in ("batch-native", "batch-vmap"):
+                engine = "other"
+            self._m_batch_lanes.observe(float(lanes), engine=engine)
+            if early:
+                self._m_batch_early.inc(early)
+            self._m_batch_occupancy.set(float(fields.get("occupancy", 0.0)))
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)
@@ -548,6 +590,8 @@ class ServiceStats:
                 "device-mesh",
                 "auto",
                 "unknown",
+                "batch-native",
+                "batch-vmap",
             ):
                 backend = "other"
             self._m_wall.observe(

@@ -1,0 +1,232 @@
+"""Continuous-batching gate: mega-launches must change throughput, not truth.
+
+Topology under test: one live ``serve`` subprocess with ``--batching``
+(native lane engine — the CPU-node production configuration) driving
+every worker pick through the cross-job batcher.
+
+Scenario, all against one-shot ``check`` ground truth:
+
+1. **Mixed-shape corpus** — distinct-fingerprint histories across
+   several shape templates plus alternating non-linearizable twins, so
+   launches group, verdicts mix inside one launch, and the late-join
+   drain has traffic to absorb.
+2. **Concurrent load** — submitter threads push the corpus (with
+   duplicate resubmissions mid-stream) at the daemon.  Assertions:
+   **zero lost jobs** (every submission gets a reply), **verdict parity**
+   with the one-shot CLI for every single reply, and the unique-traffic
+   throughput beats the published single-daemon ``service_jobs_per_sec``
+   baseline (batching must not cost the unbatched number).
+3. **Batching actually ran** — the stats stream must show
+   ``batch_launch`` events with multi-lane launches and the per-job
+   ``done`` events they fan out (batched jobs keep individual
+   attribution; none may inherit the mega-launch wall).
+
+Exit 0 when every assertion holds; 1 with failures on stderr.  One JSON
+summary line lands on stdout.  ``make batch`` runs this; ``make
+chaos-full`` includes it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from chaos_bench import build_corpus, one_shot_verdicts  # noqa: E402
+from service_bench import _published_baseline, _unique_histories  # noqa: E402
+
+from s2_verification_tpu.service.client import (  # noqa: E402
+    VerifydBusy,
+    VerifydClient,
+    VerifydError,
+)
+
+#: Throughput floor when BASELINE.json has no published row: the
+#: baseline recorded when the serving stack first shipped.
+FALLBACK_BASELINE_JOBS_PER_SEC = 333.14
+
+
+def _spawn_daemon(tmp: str) -> tuple[subprocess.Popen, str, str]:
+    sock = os.path.join(tmp, "verifyd.sock")
+    stats_log = os.path.join(tmp, "stats.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "s2_verification_tpu", "serve",
+            "-socket", sock,
+            "--workers", "2",
+            "--device", "off",
+            "-no-viz",
+            "--batching",
+            "--batch-engine", "native",
+            "--stats-log", stats_log,
+            "-out-dir", os.path.join(tmp, "viz"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    probe = VerifydClient(sock)
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError("daemon died during startup")
+        try:
+            probe.ping()
+            return proc, sock, stats_log
+        except (VerifydError, OSError):
+            if time.monotonic() > deadline:
+                proc.kill()
+                raise RuntimeError("daemon never answered ping")
+            time.sleep(0.05)
+
+
+def main() -> int:
+    failures: list[str] = []
+    tmp = tempfile.mkdtemp(prefix="batch-check-")
+
+    # Mixed shapes: three generated templates (all OK, fingerprint
+    # distinct) + the alternating good/bad chaos corpus (ILLEGAL lanes
+    # inside otherwise-OK launches).
+    corpus: list[tuple[str, str]] = [
+        (f"uniq{i}", t) for i, t in enumerate(_unique_histories(60))
+    ] + build_corpus(12)
+    expect = one_shot_verdicts(corpus, tmp)
+
+    proc, sock, stats_log = _spawn_daemon(tmp)
+    lock = threading.Lock()
+    replies: list[tuple[str, int | None, bool, float]] = []
+    # Duplicates mid-stream: every history twice, interleaved.
+    work = [(name, text) for _ in range(2) for name, text in corpus]
+
+    def submitter(lo: int, hi: int) -> None:
+        client = VerifydClient(sock, timeout=120)
+        for name, text in work[lo:hi]:
+            t0 = time.monotonic()
+            try:
+                while True:
+                    try:
+                        r = client.submit(text, client="batchgate", no_viz=True)
+                        break
+                    except VerifydBusy as e:
+                        time.sleep(min(e.retry_after_s, 2.0))
+                verdict, cached = r.get("verdict"), bool(r.get("cached"))
+            except (VerifydError, OSError) as e:
+                verdict, cached = None, False
+                with lock:
+                    failures.append(f"{name}: submit failed: {e!r}")
+            with lock:
+                replies.append((name, verdict, cached, time.monotonic() - t0))
+
+    n_threads = 8
+    per = (len(work) + n_threads - 1) // n_threads
+    t_start = time.monotonic()
+    threads = [
+        threading.Thread(target=submitter, args=(i * per, (i + 1) * per))
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t_start
+
+    # 1. zero lost jobs: every submission answered with a verdict
+    if len(replies) != len(work):
+        failures.append(f"lost jobs: {len(work) - len(replies)} unanswered")
+    for name, verdict, _, _ in replies:
+        if verdict is None:
+            failures.append(f"{name}: no verdict")
+        elif verdict != expect[name]:
+            failures.append(
+                f"{name}: verdict {verdict} != one-shot {expect[name]}"
+            )
+
+    # 2. throughput floor: must beat the published single-daemon baseline
+    baseline = _published_baseline() or FALLBACK_BASELINE_JOBS_PER_SEC
+    jobs_per_sec = round(len(replies) / wall, 2) if wall > 0 else 0.0
+    if jobs_per_sec < baseline:
+        failures.append(
+            f"throughput {jobs_per_sec} jobs/s below published baseline "
+            f"{baseline}"
+        )
+
+    # 3. batching exercised, per-job attribution intact
+    # (graceful shutdown below flushes the stats stream first)
+    client = VerifydClient(sock, timeout=60)
+    try:
+        client.shutdown(timeout=60.0, drain=True, drain_timeout_s=30.0)
+    except (VerifydError, OSError):
+        pass
+    try:
+        proc.wait(timeout=60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        failures.append("daemon did not exit after drain shutdown")
+
+    events = []
+    try:
+        with open(stats_log, encoding="utf-8") as f:
+            events = [json.loads(l) for l in f if l.strip()]
+    except OSError as e:
+        failures.append(f"stats log unreadable: {e!r}")
+    launches = [e for e in events if e.get("ev") == "batch_launch"]
+    done = [e for e in events if e.get("ev") == "done"]
+    multi = [e for e in launches if e["lanes"] > 1]
+    if not multi:
+        failures.append("no multi-lane batch_launch events — batching idle")
+    lanes_launched = sum(e["lanes"] for e in launches)
+    batched_done = [e for e in done if str(e.get("backend", "")).startswith("batch-")]
+    if len(batched_done) < lanes_launched - sum(
+        1 for e in events if e.get("ev") == "job_cancelled"
+    ):
+        failures.append(
+            f"batched lanes without their own done event: "
+            f"{lanes_launched} lanes vs {len(batched_done)} batched done"
+        )
+    max_launch_wall = max((e.get("wall_s", 0.0) for e in launches), default=0.0)
+    for e in batched_done:
+        if e.get("wall_s", 0.0) > max_launch_wall + 1.0:
+            failures.append(
+                f"done wall_s {e['wall_s']} exceeds every launch wall — "
+                "mega-launch wall leaked into per-job attribution"
+            )
+            break
+
+    summary = {
+        "metric": "batch_gate_jobs_per_sec",
+        "value": jobs_per_sec,
+        "unit": "jobs/s",
+        "baseline": baseline,
+        "submitted": len(work),
+        "answered": len(replies),
+        "corpus": len(corpus),
+        "launches": len(launches),
+        "multi_lane_launches": len(multi),
+        "lanes": lanes_launched,
+        "max_lanes": max((e["lanes"] for e in launches), default=0),
+        "late_join_launches": sum(1 for e in launches if e.get("late_join")),
+        "early_exits": sum(e.get("early_exits", 0) for e in launches),
+        "cache_hits": sum(1 for _, _, c, _ in replies if c),
+        "failures": len(failures),
+    }
+    print(json.dumps(summary), flush=True)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    if not failures:
+        print("batch gate: all assertions passed", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

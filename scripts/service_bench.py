@@ -16,9 +16,18 @@ Usage:
     python scripts/service_bench.py [--histories DIR] [--socket PATH]
         [--concurrency N] [--repeat R] [--queue-depth D] [--workers W]
         [--time-budget S] [--no-viz] [--seed-collect]
+        [--unique] [--unique-jobs N] [--batching] [--batch-engine E]
 
 ``--seed-collect`` first collects a few small histories into --histories
 when the directory is empty/missing, so the script is self-contained.
+
+``--unique`` replaces the replayed corpus with ``--unique-jobs``
+generated histories that are pairwise fingerprint-distinct (a handful of
+shape templates, per-instance record payloads), each submitted exactly
+once — zero cache hits by construction.  The verdict cache serves none
+of that traffic, so the reported row — ``service_unique_jobs_per_sec``
+— is the daemon's *decide* throughput, the number continuous batching
+(``--batching``) exists to move.
 """
 
 from __future__ import annotations
@@ -95,6 +104,65 @@ def _seed_histories(out_dir: str) -> None:
         time.sleep(1.05)  # records.<epoch>.jsonl names are second-granular
 
 
+def _unique_histories(n: int) -> list[str]:
+    """``n`` pairwise-distinct histories over a few shape templates.
+
+    Each history is serial by construction (one global order of
+    call+finish pairs round-robined over the clients, reads observing
+    the fold of everything appended so far), so every verdict is OK and
+    the search cost is the realistic all-OK serving case.  Instances of
+    one template share a ``shape_key`` (only record payloads differ), so
+    ``--batching`` gets groupable traffic; payloads differ per instance,
+    so fingerprints never collide and the cache never answers.
+    """
+    import io
+
+    from s2_verification_tpu.utils import events as ev
+    from s2_verification_tpu.utils.hashing import fold_record_hashes
+
+    templates = [(2, 8), (3, 12), (4, 10)]  # (clients, total ops)
+    out: list[str] = []
+    for i in range(n):
+        clients, ops = templates[i % len(templates)]
+        h: list[ev.LabeledEvent] = []
+        log: list[int] = []
+        for step in range(ops):
+            client = step % clients
+            op_id = step
+            if step % 3 == 2 and log:
+                tail = len(log)
+                sh = fold_record_hashes(0, log)
+                h.append(ev.LabeledEvent(ev.ReadStart(), client, op_id))
+                h.append(
+                    ev.LabeledEvent(
+                        ev.ReadSuccess(tail=tail, stream_hash=sh), client, op_id
+                    )
+                )
+            else:
+                # Per-instance payloads: distinct u64s per (i, step, k).
+                recs = [
+                    (i * 1_000_003 + step * 1_009 + k * 97 + 1) & ((1 << 64) - 1)
+                    for k in range(1 + step % 2)
+                ]
+                log.extend(recs)
+                h.append(
+                    ev.LabeledEvent(
+                        ev.AppendStart(
+                            num_records=len(recs), record_hashes=tuple(recs)
+                        ),
+                        client,
+                        op_id,
+                    )
+                )
+                h.append(
+                    ev.LabeledEvent(ev.AppendSuccess(tail=len(log)), client, op_id)
+                )
+        buf = io.StringIO()
+        ev.write_history(h, buf)
+        out.append(buf.getvalue())
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--histories", default="./data")
@@ -109,6 +177,25 @@ def main() -> int:
     ap.add_argument("--no-viz", action="store_true", default=True)
     ap.add_argument("--viz", dest="no_viz", action="store_false")
     ap.add_argument("--seed-collect", action="store_true")
+    ap.add_argument("--unique", action="store_true",
+                    help="duplicate-free traffic: submit --unique-jobs "
+                    "generated fingerprint-distinct histories once each "
+                    "(no cache hits) and report "
+                    "service_unique_jobs_per_sec")
+    ap.add_argument("--unique-jobs", type=int, default=1000,
+                    help="how many distinct histories --unique generates")
+    ap.add_argument("--batching", action="store_true",
+                    help="in-process daemon only: continuous cross-job "
+                    "batching (drain a shape group into one mega-launch)")
+    ap.add_argument("--batch-engine", default="auto",
+                    choices=("auto", "native", "vmap"))
+    ap.add_argument("--no-fast-admission", dest="fast_admission",
+                    action="store_false", default=True,
+                    help="in-process daemon only: disable the fused "
+                    "single-pass admission parser")
+    ap.add_argument("--wire", default="text", choices=("text", "records"),
+                    help="submit histories as a JSONL string (text) or as "
+                    "the structured 'records' frame field")
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="in-process daemon only: serve Prometheus metrics "
                     "on this port (0 = ephemeral) and print a scrape "
@@ -151,20 +238,33 @@ def main() -> int:
         if os.environ["JAX_PLATFORMS"].strip().lower() == "cpu":
             ensure_host_device_count(args.mesh_devices)
 
-    paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
-    if not paths and args.seed_collect:
-        print(f"# seeding {args.histories} with collected histories", file=sys.stderr)
-        _seed_histories(args.histories)
+    if args.unique:
+        texts = _unique_histories(args.unique_jobs)
+        args.repeat = 1  # each distinct history exactly once
+        print(f"# {len(texts)} unique histories (no duplicates), "
+              f"{args.concurrency} submitters", file=sys.stderr)
+    else:
         paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
-    if not paths:
-        print(
-            f"# no histories under {args.histories} (use --seed-collect)",
-            file=sys.stderr,
-        )
-        return 64
-    texts = [open(p, encoding="utf-8").read() for p in paths]
-    print(f"# {len(paths)} histories x{args.repeat}, "
-          f"{args.concurrency} submitters", file=sys.stderr)
+        if not paths and args.seed_collect:
+            print(f"# seeding {args.histories} with collected histories",
+                  file=sys.stderr)
+            _seed_histories(args.histories)
+            paths = sorted(glob.glob(os.path.join(args.histories, "*.jsonl")))
+        if not paths:
+            print(
+                f"# no histories under {args.histories} (use --seed-collect)",
+                file=sys.stderr,
+            )
+            return 64
+        texts = [open(p, encoding="utf-8").read() for p in paths]
+        print(f"# {len(paths)} histories x{args.repeat}, "
+              f"{args.concurrency} submitters", file=sys.stderr)
+    records_of: list[list] | None = None
+    if args.wire == "records":
+        records_of = [
+            [json.loads(ln) for ln in t.splitlines() if ln.strip()]
+            for t in texts
+        ]
 
     daemon_ctx = None
     router_ctx = None
@@ -256,6 +356,9 @@ def main() -> int:
                 metrics_port=args.metrics_port,
                 mesh_devices=args.mesh_devices,
                 max_rss_frac=args.max_rss_frac,
+                fast_admission=args.fast_admission,
+                batching=args.batching,
+                batch_engine=args.batch_engine,
             )
         )
         daemon_ctx.__enter__()
@@ -287,14 +390,23 @@ def main() -> int:
                     return
                 idx = cursor[0]
                 cursor[0] += 1
-            _, text = work[idx]
+            hist_i, text = work[idx]
             t0 = time.monotonic()
             try:
                 while True:
                     try:
-                        reply = client.submit(
-                            text, client=f"loadgen{worker_id}", no_viz=args.no_viz
-                        )
+                        if records_of is not None:
+                            reply = client.submit(
+                                records=records_of[hist_i],
+                                client=f"loadgen{worker_id}",
+                                no_viz=args.no_viz,
+                            )
+                        else:
+                            reply = client.submit(
+                                text,
+                                client=f"loadgen{worker_id}",
+                                no_viz=args.no_viz,
+                            )
                         break
                     except VerifydBusy as e:
                         with lock:
@@ -361,6 +473,12 @@ def main() -> int:
         elif mesh is not None:
             metric = "service_mesh_jobs_per_sec"
             backend = f"verifyd-mesh[{mesh}]"
+        elif args.unique:
+            # Duplicate-free decide throughput: its own metric name so
+            # the cache-assisted published baseline row is never mixed
+            # with a run the cache cannot help.
+            metric = "service_unique_jobs_per_sec"
+            backend = "verifyd-batch" if args.batching else "verifyd"
         else:
             metric = "service_jobs_per_sec"
             backend = "verifyd"
@@ -383,6 +501,11 @@ def main() -> int:
             "p99_ms": round(p99 * 1e3, 2),
             "shapes": shapes,
         }
+        if args.batching:
+            line["batching"] = True
+            line["batch_engine"] = args.batch_engine
+        if args.unique:
+            line["unique_jobs"] = len(texts)
         if mesh is not None:
             line["mesh_devices"] = mesh
         if args.fleet is not None:

@@ -1,0 +1,446 @@
+"""Continuous cross-job batching (ISSUE 15): verdict parity, lane
+semantics, per-lane attribution, and the fused fast-admission parser.
+
+Everything runs under the session-wide ``JAX_PLATFORMS=cpu`` pin.  The
+governing invariant throughout: batching is a fast path, never a verdict
+change — every lane the batch engines decide must match the CPU oracle,
+and every lane they cannot decide must fall back, not guess.
+"""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from s2_verification_tpu.checker import oracle
+from s2_verification_tpu.checker.batched import (
+    BatchLane,
+    check_batch_native,
+    check_batch_vmap,
+)
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier
+from s2_verification_tpu.checker.native import native_available
+from s2_verification_tpu.checker.oracle import CheckOutcome
+from s2_verification_tpu.collector.collect import CollectConfig, collect_history
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.models.encode import (
+    encode_batch,
+    encode_history,
+    pad_encoded,
+)
+from s2_verification_tpu.service.cache import VerdictCache, history_fingerprint
+from s2_verification_tpu.service.fastprep import (
+    FastPrepFallback,
+    fast_prepare,
+    slow_prepare,
+)
+from s2_verification_tpu.service.overload import CancelToken
+from s2_verification_tpu.service.queue import AdmissionQueue, Job
+from s2_verification_tpu.service.scheduler import Scheduler, shape_key
+from s2_verification_tpu.service.stats import ServiceStats
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native C engine not built"
+)
+
+
+# -- fixtures ----------------------------------------------------------------
+
+
+def _collect(workflow: str, seed: int):
+    events = collect_history(
+        CollectConfig(
+            num_concurrent_clients=3,
+            num_ops_per_client=6,
+            seed=seed,
+            workflow=workflow,
+            indefinite_failure_backoff_s=0.0,
+            faults=FaultPlan.chaos(intensity=0.25, max_latency=0.001),
+        )
+    )
+    return events, prepare(events, elide_trivial=True)
+
+
+@pytest.fixture(scope="module")
+def collected():
+    """Collected histories across every tier-1 workflow (chaos faults for
+    indefinite appends), with their prepared History."""
+    out = []
+    for workflow in ("regular", "match-seq-num", "fencing"):
+        for seed in (0, 1):
+            out.append(_collect(workflow, seed))
+    return out
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _ok_history(i: int) -> H:
+    """Serial two-client history, payloads varied by ``i`` (same shape,
+    distinct fingerprint)."""
+    h = H()
+    h.append_ok(1, [100 + i], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([100 + i]))
+    h.append_ok(2, [200 + i, 300 + i], tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([100 + i, 200 + i, 300 + i]))
+    return h
+
+
+def _bad_history(i: int) -> H:
+    h = H()
+    h.append_ok(1, [100 + i], tail=1)
+    h.read_ok(2, tail=1, stream_hash=12345)
+    h.append_ok(2, [200 + i, 300 + i], tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([100 + i, 200 + i, 300 + i]))
+    return h
+
+
+def _lanes(hists) -> list[BatchLane]:
+    return [
+        BatchLane(h, enc) for h, enc in zip(hists, encode_batch(list(hists)))
+    ]
+
+
+# -- verdict parity: batched engines vs CPU oracle ---------------------------
+
+
+@needs_native
+def test_batch_native_matches_oracle_on_collected(collected):
+    hists = [hist for _, hist in collected]
+    verdicts = check_batch_native(_lanes(hists))
+    for (_, hist), v in zip(collected, verdicts):
+        assert v.skipped is None and v.result is not None
+        assert v.engine == "batch-native"
+        assert v.result.outcome == oracle.check(hist).outcome
+
+
+def test_batch_vmap_matches_oracle_and_single_lane(collected):
+    hists = [hist for _, hist in collected]
+    batched = check_batch_vmap(_lanes(hists))
+    for (_, hist), v in zip(collected, batched):
+        single = check_batch_vmap([BatchLane(hist, encode_history(hist))])[0]
+        orc = oracle.check(hist).outcome
+        frontier = check_frontier(hist, witness=False).outcome
+        assert orc == frontier
+        # A decided lane must agree with the oracle AND with its own
+        # single-lane launch; an undecided lane may only be undecided
+        # (the serving path escalates it), never wrong.
+        if v.result is not None:
+            assert v.result.outcome == orc
+        if single.result is not None:
+            assert single.result.outcome == orc
+        assert (v.result is None) == (single.result is None)
+
+
+def test_batch_vmap_mixed_verdicts_same_launch():
+    hists = [
+        prepare((_bad_history(i) if i % 3 == 2 else _ok_history(i)).events,
+                elide_trivial=True)
+        for i in range(6)
+    ]
+    verdicts = check_batch_vmap(_lanes(hists))
+    for i, v in enumerate(verdicts):
+        assert v.result is not None, f"lane {i} undecided"
+        want = CheckOutcome.ILLEGAL if i % 3 == 2 else CheckOutcome.OK
+        assert v.result.outcome == want
+    # Early-exit observability: each lane records how deep it ran.
+    layer_counts = [v.layers for v in verdicts]
+    assert all(l >= 0 for l in layer_counts)
+
+
+def test_batch_vmap_trivial_lane_short_circuits():
+    # Every op elided (definite failure): total_remaining == 0, the lane
+    # never launches and is trivially OK at layer 0.
+    h = H()
+    h.append_definite_fail(1, [111])
+    hist = prepare(h.events, elide_trivial=True)
+    [v] = check_batch_vmap([BatchLane(hist, encode_history(hist))])
+    assert v.result is not None and v.result.outcome == CheckOutcome.OK
+    assert v.layers == 0
+
+
+@needs_native
+def test_batch_native_skip_and_on_lane_order():
+    hists = [
+        prepare(_ok_history(i).events, elide_trivial=True) for i in range(3)
+    ]
+    lanes = _lanes(hists)
+    seen: list[int] = []
+    verdicts = check_batch_native(
+        lanes,
+        skip=lambda i: "deadline" if i == 1 else None,
+        on_lane=lambda i, v: seen.append(i),
+    )
+    assert seen == [0, 1, 2]  # fires for every lane, skipped included
+    assert verdicts[1].skipped == "deadline" and verdicts[1].result is None
+    for i in (0, 2):
+        assert verdicts[i].result.outcome == CheckOutcome.OK
+
+
+# -- encode_batch / pad_encoded --------------------------------------------
+
+
+@needs_native
+def test_pad_encoded_verdicts_match_unpadded(collected):
+    from s2_verification_tpu.checker.native import check_native
+
+    for _, hist in collected:
+        enc = encode_history(hist)
+        padded = pad_encoded(
+            enc,
+            enc.op_type.shape[0] * 2,
+            enc.rh_hi.shape[0] + 3,
+            enc.rh_hi.shape[1],
+            enc.chain_ops.shape[0] + 1,
+            enc.chain_ops.shape[1] + 2,
+        )
+        assert check_native(hist, enc=padded).outcome == (
+            check_native(hist, enc=enc).outcome
+        )
+
+
+def test_encode_batch_uniform_dims(collected):
+    encs = encode_batch([hist for _, hist in collected])
+    dims = {
+        (e.op_type.shape[0], e.rh_hi.shape, e.chain_ops.shape) for e in encs
+    }
+    assert len(dims) == 1  # every lane stackable on a leading axis
+
+
+# -- the batcher against a real Scheduler -----------------------------------
+
+
+class _TripToken(CancelToken):
+    """Cancels itself with ``reason`` on the Nth ``check()`` — the
+    deterministic stand-in for a cancel/deadline landing mid-launch."""
+
+    def __init__(self, reason: str, after_checks: int) -> None:
+        super().__init__()
+        self._trip_reason = reason
+        self._left = after_checks
+
+    def check(self):
+        if self._left <= 0:
+            self.cancel(self._trip_reason)
+        else:
+            self._left -= 1
+        return super().check()
+
+
+def _make_sched(tmp_path, sink=None, engine="native", **kw):
+    stats = ServiceStats(sink=sink)
+    return Scheduler(
+        AdmissionQueue(depth=64),
+        VerdictCache(),
+        stats,
+        device="off",
+        time_budget_s=10.0,
+        out_dir=str(tmp_path),
+        batching=True,
+        batch_engine=engine,
+        **kw,
+    )
+
+
+def _make_job(sched, jid: int, h: H, token=None) -> tuple[Job, dict]:
+    hist = prepare(h.events, elide_trivial=True)
+    box: dict = {}
+    job = Job(
+        id=jid,
+        client="t",
+        priority=10,
+        shape=shape_key(hist),
+        fingerprint=history_fingerprint(hist),
+        events=list(h.events),
+        hist=hist,
+        no_viz=True,
+        cancel=token or CancelToken(),
+    )
+    job.resolve = lambda reply: box.update(reply)
+    return job, box
+
+
+@needs_native
+def test_batcher_lane_cancel_and_deadline_mid_launch(tmp_path):
+    """One launch where lane 1's client hangs up and lane 2's deadline
+    expires after prestart admitted them — both answered as cancelled
+    (started=True boundary), the other lanes decided normally."""
+    sched = _make_sched(tmp_path)
+    # after_checks=1: prestart's queue-cancel boundary passes, the skip
+    # consult immediately before the lane dispatches trips.
+    jobs_boxes = [
+        _make_job(sched, 1, _ok_history(1)),
+        _make_job(sched, 2, _ok_history(2), _TripToken("client_gone", 1)),
+        _make_job(sched, 3, _ok_history(3), _TripToken("deadline", 1)),
+        _make_job(sched, 4, _ok_history(4)),
+    ]
+    sched._batcher.run_group([j for j, _ in jobs_boxes])
+    boxes = [b for _, b in jobs_boxes]
+    assert boxes[0]["ok"]["verdict"] == 0
+    assert boxes[3]["ok"]["verdict"] == 0
+    assert boxes[1]["err"]["class"] == "Cancelled"
+    assert boxes[1]["err"]["reason"] == "client_gone"
+    assert boxes[2]["err"]["class"] == "DeadlineExceeded"
+    assert boxes[2]["err"]["reason"] == "deadline"
+
+
+@needs_native
+def test_batcher_per_lane_done_attribution(tmp_path):
+    """Satellite 2: every batched job emits its own done event whose
+    wall_s is its own pick→decide span, bounded by the launch wall — no
+    lane inherits the mega-launch total."""
+    sink = io.StringIO()
+    sched = _make_sched(tmp_path, sink=sink)
+    jobs_boxes = [
+        _make_job(sched, i + 1, _ok_history(i)) for i in range(4)
+    ]
+    sched._batcher.run_group([j for j, _ in jobs_boxes])
+    for _, box in jobs_boxes:
+        assert box["ok"]["verdict"] == 0
+        assert box["ok"]["backend"] == "batch-native"
+    events = [json.loads(l) for l in sink.getvalue().splitlines() if l.strip()]
+    launches = [e for e in events if e["ev"] == "batch_launch"]
+    assert len(launches) == 1
+    launch = launches[0]
+    assert launch["engine"] == "batch-native"
+    assert launch["lanes"] == 4 and launch["decided"] == 4
+    assert launch["early_exits"] == 3  # all but the last-to-decide
+    done = [e for e in events if e["ev"] == "done"]
+    assert sorted(e["job"] for e in done) == [1, 2, 3, 4]
+    for e in done:
+        assert e["backend"] == "batch-native"
+        # own span, not the launch total (generous slack for CI jitter:
+        # the bound being asserted is per-lane, not per-launch)
+        assert 0.0 <= e["wall_s"] <= launch["wall_s"] + 0.5
+    # aggregate counters folded the launch
+    snap = sched.stats.snapshot()
+    assert snap["batch_launches"] == 1
+    assert snap["batch_lanes"] == 4
+    assert snap["batch_early_exits"] == 3
+    families = json.dumps(snap["metrics"])
+    assert "verifyd_batch_launch_lanes" in families
+    assert "verifyd_batch_early_exits_total" in families
+    assert "verifyd_batch_launch_occupancy_ratio" in families
+
+
+@needs_native
+def test_batcher_late_join_drains_queue(tmp_path):
+    """Jobs queued while a launch is in flight join the next launch
+    boundary (drain_shape), not the next worker pick."""
+    sched = _make_sched(tmp_path)
+    first = [_make_job(sched, i + 1, _ok_history(i)) for i in range(2)]
+    late = [_make_job(sched, i + 10, _ok_history(i + 10)) for i in range(2)]
+    for j, _ in late:
+        sched.queue.put(j)
+    sched._batcher.run_group([j for j, _ in first])
+    for _, box in first + late:
+        assert box["ok"]["verdict"] == 0
+    assert len(sched.queue) == 0
+
+
+def test_drain_shape_priority_order_and_leftovers():
+    q = AdmissionQueue(depth=16)
+
+    def mk(jid, shape, priority):
+        return Job(
+            id=jid, client="t", priority=priority, shape=shape,
+            fingerprint=f"f{jid}", events=[], hist=None,
+        )
+
+    q.put(mk(1, "a", 10))
+    q.put(mk(2, "b", 10))
+    q.put(mk(3, "a", 1))
+    q.put(mk(4, "a", 10))
+    got = q.drain_shape("a", batch_max=2)
+    assert [j.id for j in got] == [3, 1]  # priority order, capped
+    assert len(q) == 2
+    assert [j.id for j in q.drain_shape("a")] == [4]
+    assert q.drain_shape("a") == []
+    assert [j.id for j in q.drain_shape("b")] == [2]
+
+
+# -- fast admission: fused parser vs layered decoder -------------------------
+
+
+def _assert_fast_matches_slow(text: str) -> None:
+    """The differential invariant: when the fast path vouches for an
+    input, the slow path must accept it and produce the identical
+    History; when the slow path rejects, the fast path must have fallen
+    back (it never vouches for garbage)."""
+    try:
+        fast = fast_prepare(text=text)
+    except FastPrepFallback:
+        return  # harmless: the canonical path words the outcome
+    events, hist = slow_prepare(text)
+    assert history_fingerprint(fast.hist) == history_fingerprint(hist)
+    assert len(fast.events) == len(events)
+    assert len(fast.hist.ops) == len(hist.ops)
+    assert fast.hist.chains == hist.chains
+
+
+def test_fastprep_matches_slow_on_collected(collected):
+    for events, _ in collected:
+        buf = io.StringIO()
+        ev.write_history(events, buf)
+        _assert_fast_matches_slow(buf.getvalue())
+
+
+def test_fastprep_matches_slow_on_builders():
+    h1 = _ok_history(7)
+    h2 = _bad_history(8)
+    h3 = H()
+    h3.append_indefinite_fail(1, [5, 6], set_token=9)
+    h3.check_tail_ok(2, tail=0)
+    h3.read_fail(1)
+    for h in (h1, h2, h3):
+        _assert_fast_matches_slow(_text(h))
+
+
+def test_fastprep_records_path_equals_text_path():
+    text = _text(_ok_history(3))
+    records = [json.loads(l) for l in text.splitlines() if l.strip()]
+    via_text = fast_prepare(text=text)
+    via_records = fast_prepare(records=records)
+    assert history_fingerprint(via_text.hist) == history_fingerprint(
+        via_records.hist
+    )
+    # wire_text round-trips records submissions back to canonical JSONL
+    # (what the journal and the replay corpus archive).
+    reparsed = fast_prepare(text=via_records.wire_text())
+    assert history_fingerprint(reparsed.hist) == history_fingerprint(
+        via_records.hist
+    )
+
+
+@pytest.mark.parametrize(
+    "mutate",
+    [
+        lambda recs: recs + [recs[0]],  # duplicate call
+        lambda recs: recs[1:],  # finish without call
+        lambda recs: [{**recs[0], "client_id": "x"}] + recs[1:],  # bad type
+        lambda recs: [{**recs[0], "op_id": -1}] + recs[1:],  # negative id
+        lambda recs: [{"event": {}, "client_id": 1, "op_id": 0}] + recs,
+        lambda recs: [{**recs[0], "event": {"start": "Bogus"}}] + recs[1:],
+    ],
+)
+def test_fastprep_never_vouches_for_malformed(mutate):
+    text = _text(_ok_history(5))
+    records = [json.loads(l) for l in text.splitlines() if l.strip()]
+    bad = mutate(records)
+    bad_text = "\n".join(json.dumps(r, separators=(",", ":")) for r in bad)
+    _assert_fast_matches_slow(bad_text)
+
+
+@pytest.mark.parametrize("garbage", ["not json", '{"event":', "[1,2,3]"])
+def test_fastprep_falls_back_on_garbage(garbage):
+    with pytest.raises(FastPrepFallback):
+        fast_prepare(text=garbage)

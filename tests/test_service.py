@@ -356,7 +356,7 @@ def test_fingerprint_stable_and_discriminating():
     b = prepare(list(ev.iter_history(bad_history())), elide_trivial=True)
     assert history_fingerprint(g1) == history_fingerprint(g2)
     assert history_fingerprint(g1) != history_fingerprint(b)
-    assert history_fingerprint(g1).startswith("v1:")
+    assert history_fingerprint(g1).startswith("v2:")
 
 
 def test_verdict_cache_lru_and_isolation():
