@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak batch perfgate lint clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak batch prefix perfgate lint clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: lint obs mesh fleet overload soak batch
+chaos-full: lint obs mesh fleet overload soak batch prefix
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -95,6 +95,14 @@ lint:
 # per-job done attribution intact.
 batch: native
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/batch_check.py
+
+# Incremental-verification gate (scripts/prefix_check.py): a live
+# --prefix daemon SIGKILLed mid-follow reboots on the same --state-dir
+# with the frontier intact and resumes warm; warm re-verification after
+# a 10% append must finish within 25% of the cold wall with the
+# identical verdict; campaign parity against a prefix-less daemon.
+prefix:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/prefix_check.py
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

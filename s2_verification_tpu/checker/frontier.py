@@ -21,6 +21,24 @@ Key structural facts it exploits (see checker/entries.py):
 - BFS layers are exhaustive: every linearization has length N, success iff
   some configuration completes all chains, failure iff a layer is empty.
 
+**Prefix resume & snapshot cuts** (the incremental-verification engine,
+see checker/prefix.py): because ops are call-ordered, a boundary after op
+K with ``max(ret of ops[:K]) < min(call of ops[K:])`` is *prefix-closed* —
+the candidate rule forces every linearization to commit exactly
+``ops[:K]`` before any later op.  ``step_set`` distributes over unions of
+state sets and the candidate/acceptance rules depend only on counts, so
+the *union* of every reachable state set at that cut is a single carried
+configuration that is verdict-equivalent to restarting from op 0:
+resume-OK iff cold-OK, provided the union is exact.  ``check_frontier``
+therefore accepts ``init_counts``/``init_states`` (resume from a carried
+cut) and ``snapshot_cuts`` (collect those unions during the search); a
+cut's union is only *complete* — and only then emitted on
+``res.snapshots`` — once every configuration in a layer has linearized
+past it, and any beam prune invalidates cuts not yet complete (a pruned
+branch could have contributed states; a subset union can produce a false
+ILLEGAL on resume, which is exactly the unsoundness the completeness rule
+exists to prevent).
+
 **Auto-close** (an optimization the reference's Porcupine search lacks):
 an indefinite-failure append whose effect branch is *dead forever* — its
 ``match_seq_num`` is below every candidate state's tail (tails are
@@ -37,7 +55,9 @@ from __future__ import annotations
 
 import time
 import zlib
+from bisect import bisect_left
 from dataclasses import dataclass, field
+from typing import Iterable
 
 from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
 from .entries import History, Op
@@ -105,6 +125,10 @@ def check_frontier(
     collect_stats: bool = False,
     witness: bool = True,
     profile: bool = False,
+    init_counts: tuple[int, ...] | None = None,
+    init_states: Iterable[StreamState] | None = None,
+    snapshot_cuts: Iterable[int] | None = None,
+    time_budget_s: float | None = None,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
 
@@ -125,6 +149,19 @@ def check_frontier(
     auto-closed in the layer, and elapsed wall seconds — on
     ``stats.timeline``, the raw material for the viz frontier panel and
     the daemon's per-job ``profile`` field.
+
+    ``init_counts``/``init_states`` resume the search from a carried cut:
+    the caller asserts the boundary was prefix-closed and the states are
+    the exact reachable-state union there (checker/prefix.py produces
+    both).  A resumed OK linearization covers only the ops searched here.
+
+    ``snapshot_cuts`` is a set of op boundaries K (each prefix-closed, as
+    computed by :func:`..checker.prefix.closed_boundaries`); on an OK
+    verdict the result carries ``res.snapshots`` — ``{K: sorted state
+    union}`` for every cut whose union completed before any prune.
+
+    ``time_budget_s`` bounds the search wall clock (checked per layer);
+    expiry returns UNKNOWN, matching the other engines' budget semantics.
     """
     collect_stats = collect_stats or profile
     ops = history.ops
@@ -133,7 +170,8 @@ def check_frontier(
     stats = FrontierStats()
 
     if not ops:
-        return CheckResult(CheckOutcome.OK, linearization=[], final_states=[INIT_STATE])
+        start = sorted(init_states) if init_states else [INIT_STATE]
+        return CheckResult(CheckOutcome.OK, linearization=[], final_states=start)
 
     settable_tokens = frozenset(
         op.inp.set_fencing_token
@@ -141,11 +179,34 @@ def check_frontier(
         if op.inp.input_type == APPEND and op.inp.set_fencing_token is not None
     )
 
-    init_counts = tuple(0 for _ in range(n_chains))
-    init_cfg = (init_counts, frozenset([INIT_STATE]))
+    if init_counts is None:
+        init_counts = tuple(0 for _ in range(n_chains))
+    else:
+        init_counts = tuple(init_counts)
+    start_states = (
+        frozenset(init_states) if init_states is not None else frozenset([INIT_STATE])
+    )
+    init_cfg = (init_counts, start_states)
     frontier: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {
         init_cfg: None
     }
+    base_sum = sum(init_counts)
+
+    # Snapshot-cut table: K -> [expected counts at the cut, state union,
+    # complete?].  The counts at a closed cut are forced (every
+    # linearization of K ops commits exactly ops[:K]), so they are derived
+    # from chain membership, and noting a config is a sum lookup plus an
+    # equality check that doubles as a self-test of closedness.
+    cuts: dict[int, list] = {}
+    for K in sorted(set(snapshot_cuts or ())):
+        if base_sum < K <= len(ops):
+            counts_k = tuple(bisect_left(chain, K) for chain in chains)
+            cuts[K] = [counts_k, set(), False]
+
+    def note_cut(counts, states) -> None:
+        cut = cuts.get(sum(counts))
+        if cut is not None and not cut[2] and counts == cut[0]:
+            cut[1].update(states)
     # Witness links: cfg -> (parent cfg, ops auto-closed at the parent's
     # layer, the expanded op) — walked backwards on accept to recover a
     # concrete linearization (same role as the device engine's witness log).
@@ -243,6 +304,10 @@ def check_frontier(
                     closed_ops.append(chains[c][counts[c]])
                     counts[c] += 1
                     stats.auto_closed += 1
+                    if cuts:
+                        # Auto-close leaves states untouched, so each
+                        # intermediate position is a reachable cut config.
+                        note_cut(tuple(counts), states)
                     changed = True
         return tuple(counts), states, closed_ops
 
@@ -259,10 +324,18 @@ def check_frontier(
             entry["auto_closed"] = stats.auto_closed - auto_before
             entry["elapsed_s"] = round(time.monotonic() - t_search, 6)
 
+    deadline = None if time_budget_s is None else t_search + time_budget_s
+
     layer = 0
     while True:
         layer += 1
         stats.layers = layer
+        if deadline is not None and time.monotonic() > deadline:
+            _finish_layer()
+            res = CheckResult(CheckOutcome.UNKNOWN, deepest=deepest_of(deep_counts))
+            if collect_stats:
+                res.stats = stats  # type: ignore[attr-defined]
+            return res
         stats.max_frontier = max(stats.max_frontier, len(frontier))
         layer_states = 0
         if profile:
@@ -281,11 +354,22 @@ def check_frontier(
         close_link: dict = {}
         for counts, states in frontier:
             pre = (counts, states)
+            if cuts:
+                note_cut(counts, states)
             counts, states, closed_ops = auto_close_config(counts, states)
             key = (counts, states)
             if key not in closed:
                 closed[key] = None
                 close_link[key] = (pre, closed_ops)
+
+        if cuts:
+            # A cut is complete once no configuration can reach it again:
+            # children of this layer sit strictly above the layer's minimum
+            # post-close sum, so every cut at or below that floor is final.
+            floor = min(sum(counts) for counts, _ in closed)
+            for K, cut in cuts.items():
+                if not cut[2] and K <= floor:
+                    cut[2] = True
 
         for counts, states in closed:
             csum = sum(counts)
@@ -306,6 +390,14 @@ def check_frontier(
                     deepest=order or [],
                     final_states=sorted(states),
                 )
+                if cuts:
+                    snaps = {
+                        K: sorted(cut[1])
+                        for K, cut in cuts.items()
+                        if cut[2] and cut[1]
+                    }
+                    if snaps:
+                        res.snapshots = snaps  # type: ignore[attr-defined]
                 if collect_stats:
                     res.stats = stats  # type: ignore[attr-defined]
                 return res
@@ -346,6 +438,12 @@ def check_frontier(
                     res.stats = stats  # type: ignore[attr-defined]
                 return res
             stats.pruned = True
+            if cuts:
+                # A pruned branch could still have contributed states to a
+                # cut not yet complete; a partial union resumed later can
+                # only produce a *false ILLEGAL* — refuse those snapshots.
+                for K in [K for K, cut in cuts.items() if not cut[2]]:
+                    del cuts[K]
             ranked = sorted(
                 children, key=lambda cfg: (opens_taken(cfg[0]), _cfg_digest(cfg))
             )
@@ -361,6 +459,10 @@ def check_frontier_auto(
     collect_stats: bool = False,
     witness: bool = True,
     profile: bool = False,
+    init_counts: tuple[int, ...] | None = None,
+    init_states: Iterable[StreamState] | None = None,
+    snapshot_cuts: Iterable[int] | None = None,
+    time_budget_s: float | None = None,
 ) -> CheckResult:
     """Beam-first frontier check with exhaustive escalation.
 
@@ -378,6 +480,10 @@ def check_frontier_auto(
         collect_stats=collect_stats,
         witness=witness,
         profile=profile,
+        init_counts=init_counts,
+        init_states=init_states,
+        snapshot_cuts=snapshot_cuts,
+        time_budget_s=time_budget_s,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
@@ -387,4 +493,8 @@ def check_frontier_auto(
         collect_stats=collect_stats,
         witness=witness,
         profile=profile,
+        init_counts=init_counts,
+        init_states=init_states,
+        snapshot_cuts=snapshot_cuts,
+        time_budget_s=time_budget_s,
     )

@@ -23,6 +23,14 @@
               its verdict (75 = queue full after retries, 69 = no daemon
               ever answered, 76 = a daemon was reached but refused after
               retries — bad secret, persistent frame errors).
+``follow``  — continuous stream monitoring: tail a growing JSONL history
+              (file or stdin), cut it into closed windows (no call left
+              dangling across the cut), and verify each window
+              incrementally against a ``--prefix`` daemon — the daemon
+              carries the decided frontier forward under a chain-hash
+              token, so window N+1 costs its own ops, not the stream's.
+              An unknown frontier (evicted, node swapped) resyncs with
+              one full-history submit.
 ``soak``    — the closed verification loop: generate ground-truth-labeled
               histories from seeded fault campaigns (``collect
               --list-campaigns``), submit each to a live daemon or router
@@ -637,6 +645,11 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         fast_admission=args.fast_admission,
         batching=args.batching,
         batch_engine=args.batch_engine,
+        prefix_enabled=args.prefix,
+        prefix_capacity=args.prefix_capacity,
+        prefix_min_ops=args.prefix_min_ops,
+        prefix_cuts=args.prefix_cuts,
+        prefix_max_segments=args.prefix_max_segments,
     )
     daemon = Verifyd(cfg)
 
@@ -1352,6 +1365,208 @@ def _cmd_submit(args: argparse.Namespace) -> int:
     return verdict if verdict in (0, 1, 2) else USAGE_EXIT
 
 
+def _iter_follow_windows(lines, window_events: int):
+    """Cut a JSONL event stream into prefix-closed windows.
+
+    Yields ``(window_lines, dangling)`` chunks: a window is flushed only
+    when every call in the buffer has returned (no op spans the cut) and
+    at least ``window_events`` lines accumulated.  The final chunk
+    carries whatever remains at EOF — ``dangling`` is the set of op ids
+    still open there (a truncated tail the daemon would refuse).
+    """
+    import json as _json
+
+    from .utils import events as ev
+
+    buf: list = []
+    open_ops: set = set()
+    for raw in lines:
+        line = raw.strip()
+        if not line:
+            continue
+        le = ev.decode_obj(_json.loads(line))
+        if le.is_start:
+            open_ops.add((le.client_id, le.op_id))
+        else:
+            open_ops.discard((le.client_id, le.op_id))
+        buf.append(line)
+        if not open_ops and len(buf) >= window_events:
+            yield buf, set()
+            buf = []
+    if buf:
+        yield buf, set(open_ops)
+
+
+def _cmd_follow(args: argparse.Namespace) -> int:
+    import json as _json
+
+    from .service.client import (
+        VerifydBusy,
+        VerifydClient,
+        VerifydError,
+        VerifydRefused,
+        VerifydUnavailable,
+    )
+    from .service.protocol import (
+        ERR_FRONTIER,
+        EXIT_BUSY,
+        EXIT_PROTOCOL,
+        EXIT_UNAVAILABLE,
+    )
+    from .utils import events as ev
+
+    if args.file == "-":
+        source = sys.stdin
+        close = False
+    else:
+        try:
+            source = open(args.file, encoding="utf-8")
+            close = True
+        except OSError as e:
+            log.error("failed to read history: %s", e)
+            return USAGE_EXIT
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        if close:
+            source.close()
+        return USAGE_EXIT
+
+    frontier = args.frontier
+    committed: list = []  # every line already verified — the resync body
+    window = 0
+    worst = 0
+    try:
+        for chunk, dangling in _iter_follow_windows(source, args.window):
+            if dangling:
+                log.warning(
+                    "stream tail has %d call(s) with no return — an op "
+                    "would span the window cut; skipping the last %d "
+                    "line(s)",
+                    len(dangling),
+                    len(chunk),
+                )
+                break
+            text = "\n".join(chunk) + "\n"
+            try:
+                try:
+                    reply = client.follow(
+                        text,
+                        stream=args.stream,
+                        frontier=frontier,
+                        client=args.client,
+                        priority=args.priority,
+                        timeout=args.timeout,
+                        deadline_s=args.deadline,
+                    )
+                except VerifydError as e:
+                    if e.cls != ERR_FRONTIER:
+                        raise
+                    # The daemon no longer knows our frontier (evicted,
+                    # restarted without state, or a router moved the
+                    # stream): resync by replaying the whole committed
+                    # stream plus this window as a fresh lineage.
+                    log.warning(
+                        "frontier unknown at window %d — resyncing with "
+                        "%d committed line(s)",
+                        window,
+                        len(committed),
+                    )
+                    reply = client.follow(
+                        "\n".join(committed + chunk) + "\n",
+                        stream=args.stream,
+                        frontier=None,
+                        client=args.client,
+                        priority=args.priority,
+                        timeout=args.timeout,
+                        deadline_s=args.deadline,
+                    )
+            except VerifydBusy as e:
+                log.error(
+                    "verifyd is at capacity (%s); retry after ~%.1fs",
+                    e.msg,
+                    e.retry_after_s,
+                )
+                return EXIT_BUSY
+            except VerifydUnavailable as e:
+                log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+                return EXIT_UNAVAILABLE
+            except VerifydError as e:
+                if e.cls == "DecodeError":
+                    log.error("daemon rejected the window: %s", e.msg)
+                    return USAGE_EXIT
+                log.error("follow failed: %s", e)
+                return EXIT_PROTOCOL
+
+            verdict = reply.get("verdict")
+            if args.stats:
+                print(
+                    _json.dumps(
+                        {
+                            "stream": args.stream,
+                            "window": window,
+                            "ops": reply.get("ops"),
+                            "ops_total": reply.get("ops_total"),
+                            "verdict": verdict,
+                            "backend": reply.get("backend"),
+                            "frontier": reply.get("frontier"),
+                            "advanced": reply.get("advanced"),
+                            "wall_s": reply.get("wall_s"),
+                        }
+                    ),
+                    flush=True,
+                )
+            if verdict == 1:
+                log.error(
+                    "stream %s is NOT linearizable at window %d "
+                    "(%d ops total)",
+                    args.stream,
+                    window,
+                    reply.get("ops_total") or 0,
+                )
+                return 1
+            if verdict != 0:
+                log.error(
+                    "window %d inconclusive (outcome %s)",
+                    window,
+                    reply.get("outcome"),
+                )
+                worst = max(worst, 2)
+            else:
+                log.info(
+                    "window %d ok: %s ops carried to %s ops total (%s%s)",
+                    window,
+                    reply.get("ops"),
+                    reply.get("ops_total"),
+                    reply.get("backend"),
+                    "" if reply.get("advanced") else ", frontier NOT advanced",
+                )
+            committed.extend(chunk)
+            if reply.get("advanced") and reply.get("frontier"):
+                frontier = reply["frontier"]
+            window += 1
+    except (ev.DecodeError, ValueError) as e:
+        log.error("undecodable stream line: %s", e)
+        return USAGE_EXIT
+    except (OSError, TimeoutError) as e:
+        log.error("cannot reach verifyd on %s: %s", args.socket, e)
+        return EXIT_UNAVAILABLE
+    finally:
+        if close:
+            source.close()
+    if window == 0:
+        log.error("stream held no closed window — nothing verified")
+        return USAGE_EXIT
+    log.info(
+        "stream %s: %d window(s) verified, frontier %s",
+        args.stream,
+        window,
+        frontier,
+    )
+    return worst
+
+
 def _cmd_soak(args: argparse.Namespace) -> int:
     import json as _json
 
@@ -1991,6 +2206,49 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable the fused single-pass admission parser and decode "
         "every submission through the layered event decoder",
     )
+    s.add_argument(
+        "--prefix",
+        action="store_true",
+        help="incremental prefix verification: snapshot the decided "
+        "frontier at closed op boundaries of every OK search, keyed by "
+        "the chain-hash of the committed prefix, so a resubmission that "
+        "extends a verified history resumes at the deepest cached cut "
+        "instead of op 0 — and enable the 'follow' op for rolling-window "
+        "stream monitoring.  Snapshots persist under --state-dir and "
+        "survive restarts",
+    )
+    s.add_argument(
+        "--prefix-capacity",
+        type=int,
+        default=2048,
+        metavar="N",
+        help="in-memory prefix-store entries before LRU eviction "
+        "(default 2048)",
+    )
+    s.add_argument(
+        "--prefix-min-ops",
+        type=int,
+        default=4,
+        metavar="N",
+        help="histories shorter than this skip the prefix probe — the "
+        "cold search is cheaper than the bookkeeping (default 4)",
+    )
+    s.add_argument(
+        "--prefix-cuts",
+        type=int,
+        default=8,
+        metavar="N",
+        help="snapshot cuts recorded per OK search (deepest boundary "
+        "always included; the rest spread evenly) (default 8)",
+    )
+    s.add_argument(
+        "--prefix-max-segments",
+        type=int,
+        default=8,
+        metavar="N",
+        help="on-disk prefix log segments before the oldest rotates out "
+        "(default 8)",
+    )
     s.set_defaults(fn=_cmd_serve, stats=False)
 
     r = sub.add_parser(
@@ -2466,6 +2724,85 @@ def build_parser() -> argparse.ArgumentParser:
         "queue wait, cache hit) on stdout",
     )
     u.set_defaults(fn=_cmd_submit)
+
+    fo = sub.add_parser(
+        "follow",
+        help="verify a growing event stream window-by-window against a "
+        "--prefix daemon (the decided frontier is carried forward, so "
+        "each window costs its own ops)",
+    )
+    fo.add_argument(
+        "-file",
+        "--file",
+        required=True,
+        help="history JSONL path, '-' for stdin (pipe a live collector "
+        "into it)",
+    )
+    fo.add_argument(
+        "-socket",
+        "--socket",
+        required=True,
+        help="the daemon's unix-socket path, or HOST:PORT for the "
+        "authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET); a router address works — streams route by "
+        "stream id",
+    )
+    fo.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    fo.add_argument(
+        "--stream",
+        required=True,
+        help="stream identity: scopes the frontier lineage and (behind a "
+        "router) pins every window to one backend",
+    )
+    fo.add_argument(
+        "--frontier",
+        default=None,
+        help="resume from a frontier token printed by an earlier run "
+        "(default: start a fresh lineage at window 0)",
+    )
+    fo.add_argument(
+        "--window",
+        type=int,
+        default=256,
+        metavar="EVENTS",
+        help="events per window: a window is cut at the first point at "
+        "or after this many lines where no call is still open "
+        "(default 256)",
+    )
+    fo.add_argument("--client", default="cli", help="client identity for the queue")
+    fo.add_argument(
+        "--priority",
+        type=int,
+        default=10,
+        help="admission priority (lower = scheduled sooner; default 10)",
+    )
+    fo.add_argument(
+        "--timeout",
+        type=float,
+        default=None,
+        help="seconds to wait for each window's verdict (default: wait)",
+    )
+    fo.add_argument(
+        "--deadline",
+        type=float,
+        default=None,
+        metavar="SECONDS",
+        help="per-window end-to-end deadline forwarded to the daemon "
+        "(default: unbounded)",
+    )
+    fo.add_argument(
+        "-stats",
+        "--stats",
+        action="store_true",
+        help="print one machine-readable JSON line per window (verdict, "
+        "backend, frontier token, ops carried) on stdout",
+    )
+    fo.set_defaults(fn=_cmd_follow)
 
     k = sub.add_parser(
         "soak",

@@ -242,6 +242,24 @@ def postmortem(
     except (OSError, ValueError):
         pass
 
+    # Prefix store: cold replay of the chain-hash frontier log, plus the
+    # hit/miss picture from the flight events — together they answer
+    # "was incremental verification pulling its weight when it died?".
+    from ..service.prefixstore import read_cold as read_prefix_cold
+
+    prefix_store = read_prefix_cold(state_dir)
+    prefix_activity: Dict[str, int] = {}
+    for ev in events:
+        name = ev.get("ev") or ev.get("event")
+        if name in (
+            "prefix_hit",
+            "prefix_miss",
+            "prefix_snapshot",
+            "prefix_refused",
+            "window_done",
+        ):
+            prefix_activity[name] = prefix_activity.get(name, 0) + 1
+
     return {
         "state_dir": state_dir,
         "records": len(records),
@@ -262,6 +280,8 @@ def postmortem(
         "cancellations": cancellations,
         "slowest_spans": slowest,
         "slo_at_death": slo_at_death,
+        "prefix_store": prefix_store,
+        "prefix_activity": prefix_activity,
         # Resource timeline before death: keep the tail — the interesting
         # part of an OOM story is the last few minutes, not the first.
         "resources": resources[-tail:],
@@ -451,6 +471,64 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                     r.get("backend"),
                     r.get("verdict"),
                     r.get("client"),
+                )
+            )
+
+    ps = pm.get("prefix_store")
+    activity = pm.get("prefix_activity") or {}
+    if ps is not None or activity:
+        add("")
+        if ps is None:
+            add("-- prefix store: no on-disk log (in-memory only) --")
+        else:
+            rec = ps.get("recovery") or {}
+            add(
+                "-- prefix store: %d frontier(s), %d bytes, deepest %d ops --"
+                % (ps.get("entries", 0), ps.get("bytes", 0), ps.get("deepest_ops", 0))
+            )
+            add(
+                "  log: %s segment(s), %s record(s) replayed, "
+                "torn tail %sB, %s bad segment(s)"
+                % (
+                    rec.get("segments", "?"),
+                    rec.get("records", "?"),
+                    rec.get("torn_tail_bytes", "?"),
+                    rec.get("bad_segments", "?"),
+                )
+            )
+            for stream, info in sorted(ps.get("streams", {}).items())[:10]:
+                add(
+                    "  stream %-20s frontier at %d ops (window %s, %d events)"
+                    % (
+                        stream,
+                        info.get("ops", 0),
+                        info.get("window", "?"),
+                        info.get("events", 0),
+                    )
+                )
+        hits = activity.get("prefix_hit", 0)
+        misses = activity.get("prefix_miss", 0)
+        if hits or misses:
+            add(
+                "  probes: %d hit / %d miss (%.0f%% warm), %d snapshot(s), "
+                "%d refused, %d window(s)"
+                % (
+                    hits,
+                    misses,
+                    100.0 * hits / (hits + misses),
+                    activity.get("prefix_snapshot", 0),
+                    activity.get("prefix_refused", 0),
+                    activity.get("window_done", 0),
+                )
+            )
+        elif activity:
+            add(
+                "  probes: none recorded; %d snapshot(s), %d refused, "
+                "%d window(s)"
+                % (
+                    activity.get("prefix_snapshot", 0),
+                    activity.get("prefix_refused", 0),
+                    activity.get("window_done", 0),
                 )
             )
 

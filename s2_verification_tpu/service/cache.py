@@ -93,6 +93,26 @@ def _op_canon(op: Op) -> bytes:
     return b"".join((head, hashes, *toks))
 
 
+def _op_digest(op: Op) -> int:
+    """xxh3 of one op's canonical serialization (with the repr fallback).
+
+    Shared by the full-history fold below and the per-cut prefix
+    accumulators in service/prefixstore.py, which must fold the exact same
+    per-op digests so a prefix key computed incrementally matches one
+    computed from the full history.
+    """
+    try:
+        canon = _op_canon(op)
+    except struct.error:
+        # client_id past u64 or a similarly absurd-but-decodable value:
+        # fall back to the deterministic repr canon for this op.
+        canon = (
+            f"{op.client_id}|{op.call}|{op.ret}|{op.pending}|"
+            f"{op.inp!r}|{op.out!r}"
+        ).encode("utf-8")
+    return record_hash(canon)
+
+
 def history_fingerprint(hist: History) -> str:
     """Canonical chain-hash fingerprint of a prepared history.
 
@@ -107,16 +127,7 @@ def history_fingerprint(hist: History) -> str:
     """
     acc = 0
     for op in hist.ops:
-        try:
-            canon = _op_canon(op)
-        except struct.error:
-            # client_id past u64 or a similarly absurd-but-decodable value:
-            # fall back to the deterministic repr canon for this op.
-            canon = (
-                f"{op.client_id}|{op.call}|{op.ret}|{op.pending}|"
-                f"{op.inp!r}|{op.out!r}"
-            ).encode("utf-8")
-        acc = chain_hash(acc, record_hash(canon))
+        acc = chain_hash(acc, _op_digest(op))
     return f"{_FP_VERSION}:{acc:016x}:{len(hist.ops)}"
 
 

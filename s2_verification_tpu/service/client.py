@@ -353,6 +353,53 @@ class VerifydClient:
             reply.setdefault("trace_id", tid)
         return reply
 
+    def follow(
+        self,
+        history_text: str | None = None,
+        *,
+        records: list | None = None,
+        stream: str,
+        frontier: str | None = None,
+        client: str = "client",
+        priority: int = 10,
+        timeout: float | None = None,
+        trace_id: str | None = None,
+        deadline_s: float | None = None,
+    ) -> dict:
+        """Verify one rolling window of a continuously monitored stream.
+
+        ``frontier`` is the token echoed by the previous window's reply
+        (None starts a lineage).  The reply is window-scoped: it carries
+        ``verdict`` for the stream-so-far, ``advanced`` (whether the
+        committed frontier moved) and the next ``frontier`` token.  A
+        daemon that lost the token answers the definite
+        ``UnknownFrontier`` — callers resync with a full :meth:`submit`.
+        """
+        if (history_text is None) == (records is None):
+            raise ValueError("follow takes exactly one of history_text / records")
+        if not stream:
+            raise ValueError("follow needs a non-empty stream id")
+        tid = trace_id or new_trace_id()
+        req: dict = {
+            "op": "follow",
+            "client": client,
+            "priority": priority,
+            "stream": stream,
+            TRACE_FIELD: trace_frame(tid),
+        }
+        if records is not None:
+            req["records"] = records
+        else:
+            req["history"] = history_text
+        if frontier is not None:
+            req["frontier"] = frontier
+        if deadline_s is not None:
+            req["deadline"] = float(deadline_s)
+        reply = self._call(req, timeout=timeout)
+        if isinstance(reply, dict):
+            reply.setdefault("trace_id", tid)
+        return reply
+
     def submit_with_retry(
         self,
         history_text: str,

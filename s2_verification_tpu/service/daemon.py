@@ -60,11 +60,19 @@ from .overload import (
     DegradedWriter,
     QuarantineStore,
 )
+from .prefixstore import (
+    PREFIX_SUBDIR,
+    PrefixStore,
+    make_entry,
+    plan_for_submit,
+    plan_for_window,
+)
 from .protocol import (
     ERR_AUTH,
     ERR_DEADLINE,
     ERR_DECODE,
     ERR_FRAME,
+    ERR_FRONTIER,
     ERR_INTERNAL,
     ERR_QUARANTINED,
     ERR_QUEUE_FULL,
@@ -202,6 +210,23 @@ class VerifydConfig:
     batching: bool = False
     #: lane engine for mega-launches: auto | native | vmap
     batch_engine: str = "auto"
+    #: incremental prefix verification (service/prefixstore.py): probe
+    #: incoming histories for cached prefixes, snapshot OK searches at
+    #: closed op boundaries, and serve the ``follow`` op.  Opt-in
+    #: (``serve --prefix``): planned jobs run the resumable host-frontier
+    #: engine instead of the native/oracle portfolio.
+    prefix_enabled: bool = False
+    #: in-memory prefix-store entries (LRU); disk is bounded separately
+    #: by segment rotation
+    prefix_capacity: int = 2048
+    #: histories below this many ops never probe or snapshot (the cold
+    #: engines already answer them faster than a store round-trip)
+    prefix_min_ops: int = 4
+    #: snapshot cuts collected per OK search (probes still check every
+    #: closed boundary — lookups are cheap, snapshots are not)
+    prefix_cuts: int = 8
+    #: prefix-store segment-rotation bound under <state_dir>/prefix/
+    prefix_max_segments: int = 8
     extra: dict = field(default_factory=dict)
 
 
@@ -340,6 +365,36 @@ class Verifyd:
                 torn_tail_bytes=rec.torn_tail_bytes if rec else 0,
                 bad_segments=rec.bad_segments if rec else 0,
             )
+        self.prefix = None
+        self._prefix_writer = None
+        if config.prefix_enabled:
+            prefix_dir = (
+                os.path.join(config.state_dir, PREFIX_SUBDIR)
+                if config.state_dir
+                else None
+            )
+            self._prefix_writer = (
+                DegradedWriter("prefix", self.stats)
+                if prefix_dir is not None
+                else None
+            )
+            self.prefix = PrefixStore(
+                config.prefix_capacity,
+                prefix_dir,
+                fsync=config.fsync,
+                max_segments=config.prefix_max_segments,
+                writer=self._prefix_writer,
+            )
+            if prefix_dir is not None:
+                rec = self.prefix.recovery
+                self.stats.emit(
+                    "prefix_loaded",
+                    entries=self.prefix.loaded,
+                    bytes=self.prefix.bytes,
+                    segments=rec.segments if rec else 0,
+                    torn_tail_bytes=rec.torn_tail_bytes if rec else 0,
+                    bad_segments=rec.bad_segments if rec else 0,
+                )
         self.journal = (
             JobJournal(os.path.join(config.state_dir, "journal"), fsync=config.fsync)
             if config.state_dir
@@ -407,6 +462,7 @@ class Verifyd:
             cancel_grace_s=config.deadline_grace_s,
             batching=config.batching,
             batch_engine=config.batch_engine,
+            prefix_store=self.prefix,
         )
         self._job_ids = itertools.count(1)
         #: submits between dispatch and reply-written (loop thread owns
@@ -500,6 +556,8 @@ class Verifyd:
         if self.archive is not None:
             self.archive.close()
         self.cache.close()
+        if self.prefix is not None:
+            self.prefix.close()
         if self.journal is not None:
             self.journal.close()
         if self._stats_file is not None:
@@ -788,10 +846,11 @@ class Verifyd:
                             resp = err(ERR_AUTH, "missing or invalid frame auth")
                             close_after = True
                         else:
-                            if req.get("op") == "submit":
-                                # Drain counts a submit until its reply is
-                                # *written* — an accepted job whose verdict
-                                # never reached the client is a lost job.
+                            if req.get("op") in ("submit", "follow"):
+                                # Drain counts a submit (or follow window)
+                                # until its reply is *written* — an accepted
+                                # job whose verdict never reached the
+                                # client is a lost job.
                                 inflight = True
                                 # Single-threaded by construction: every
                                 # _handle coroutine runs on the accept
@@ -934,22 +993,24 @@ class Verifyd:
                 return err(ERR_DECODE, f"unknown quarantine action {action!r}")
             if op == "submit":
                 return await self._submit(req, reader)
+            if op == "follow":
+                return await self._follow(req, reader)
             return err(ERR_DECODE, f"unknown op {op!r}")
         except Exception as e:  # protocol handler must never kill the loop
             log.exception("dispatch failed for op %r", op)
             return err(ERR_INTERNAL, repr(e))
 
-    async def _submit(
-        self, req: dict, reader: asyncio.StreamReader | None = None
-    ) -> dict:
-        t_recv = self.tracer.now()
-        # Distributed trace context: honor a client-minted id (new
-        # clients), mint one otherwise (old clients) — every job traces.
-        trace_id, sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
-        if trace_id is None:
-            trace_id = new_trace_id()
-        text = req.get("history")
-        records = req.get("records")
+    def _decode_history(
+        self, text, records, client: str
+    ) -> tuple[str | None, list, object] | dict:
+        """Shared submit/follow decode: validate and prepare one history
+        payload, returning ``(text, events, hist)`` or an error frame.
+
+        Fast admission first: one fused parse+pair+validate+build pass
+        (service/fastprep.py).  Fallback-not-fork: anything the fast path
+        won't vouch for re-runs through the layered decoder below, which
+        produces the canonical error message for every rejection.
+        """
         if records is not None:
             # Structured submission: the client ships the event records as
             # a JSON array instead of a JSONL string, skipping one
@@ -965,6 +1026,49 @@ class Verifyd:
         elif not isinstance(text, str) or not text.strip():
             self.stats.emit("decode_error", reason="missing history")
             return err(ERR_DECODE, "submit needs a non-empty 'history' JSONL string")
+        prep = None
+        if self.cfg.fast_admission:
+            try:
+                prep = fast_prepare(text=text, records=records)
+            except FastPrepFallback:
+                prep = None
+        if prep is not None:
+            events = prep.events
+            hist = prep.hist
+            if text is None:
+                text = prep.wire_text()
+            return text, events, hist
+        if text is None:
+            try:
+                text = "\n".join(
+                    json.dumps(r, separators=(",", ":")) for r in records
+                )
+            except (TypeError, ValueError) as e:
+                self.stats.emit(
+                    "decode_error", client=client, reason=str(e)[:200]
+                )
+                return err(
+                    ERR_DECODE, f"'records' are not JSON-serializable: {e}"
+                )
+        try:
+            events = list(ev.iter_history(text))
+            hist = prepare(events, elide_trivial=True)
+        except (ev.DecodeError, ValueError) as e:
+            self.stats.emit("decode_error", client=client, reason=str(e)[:200])
+            return err(ERR_DECODE, str(e))
+        return text, events, hist
+
+    async def _submit(
+        self, req: dict, reader: asyncio.StreamReader | None = None
+    ) -> dict:
+        t_recv = self.tracer.now()
+        # Distributed trace context: honor a client-minted id (new
+        # clients), mint one otherwise (old clients) — every job traces.
+        trace_id, sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
+        text = req.get("history")
+        records = req.get("records")
         client = str(req.get("client") or "anon")
         try:
             priority = int(req.get("priority", 10))
@@ -984,40 +1088,10 @@ class Verifyd:
                 )
 
         t_prep0 = self.tracer.now()
-        # Fast admission: one fused parse+pair+validate+build pass
-        # (service/fastprep.py).  Fallback-not-fork: anything the fast
-        # path won't vouch for re-runs through the layered decoder below,
-        # which produces the canonical error message for every rejection.
-        prep = None
-        if self.cfg.fast_admission:
-            try:
-                prep = fast_prepare(text=text, records=records)
-            except FastPrepFallback:
-                prep = None
-        if prep is not None:
-            events = prep.events
-            hist = prep.hist
-            if text is None:
-                text = prep.wire_text()
-        else:
-            if text is None:
-                try:
-                    text = "\n".join(
-                        json.dumps(r, separators=(",", ":")) for r in records
-                    )
-                except (TypeError, ValueError) as e:
-                    self.stats.emit(
-                        "decode_error", client=client, reason=str(e)[:200]
-                    )
-                    return err(
-                        ERR_DECODE, f"'records' are not JSON-serializable: {e}"
-                    )
-            try:
-                events = list(ev.iter_history(text))
-                hist = prepare(events, elide_trivial=True)
-            except (ev.DecodeError, ValueError) as e:
-                self.stats.emit("decode_error", client=client, reason=str(e)[:200])
-                return err(ERR_DECODE, str(e))
+        decoded = self._decode_history(text, records, client)
+        if isinstance(decoded, dict):
+            return decoded
+        text, events, hist = decoded
         t_prep1 = self.tracer.now()
 
         fingerprint = history_fingerprint(hist)
@@ -1102,6 +1176,52 @@ class Verifyd:
                 depth=len(self.queue),
             )
 
+        # Prefix probe (service/prefixstore.py): fold the chain-hash
+        # frontier of the incoming history, ask the store for the deepest
+        # cached cut, and plan where the search snapshots next.  Planned
+        # jobs run the resumable host-frontier engine in the scheduler.
+        plan = None
+        if self.prefix is not None:
+            plan = plan_for_submit(
+                self.prefix,
+                hist,
+                max_cuts=self.cfg.prefix_cuts,
+                min_ops=self.cfg.prefix_min_ops,
+            )
+            if plan is not None:
+                plan.total_events = len(events)
+                if plan.carry is not None:
+                    self.stats.emit(
+                        "prefix_hit",
+                        client=client,
+                        resume_ops=plan.resume_ops,
+                        ops=len(hist.ops),
+                        depth_frac=round(
+                            plan.resume_ops / max(1, len(hist.ops)), 4
+                        ),
+                        probed=plan.probed,
+                        trace_id=trace_id,
+                    )
+                    # A resumed search replays no linearization prefix: the
+                    # witness would be partial, so the artifact is skipped.
+                    no_viz = True
+                else:
+                    self.stats.emit(
+                        "prefix_miss",
+                        client=client,
+                        ops=len(hist.ops),
+                        probed=plan.probed,
+                        trace_id=trace_id,
+                    )
+                if plan.refused:
+                    self.stats.emit(
+                        "prefix_refused",
+                        op="submit",
+                        reason=plan.refused,
+                        client=client,
+                        trace_id=trace_id,
+                    )
+
         cancel = CancelToken(
             time.monotonic() + deadline if deadline is not None else None
         )
@@ -1116,6 +1236,7 @@ class Verifyd:
             no_viz=no_viz,
             trace_id=trace_id,
             cancel=cancel,
+            prefix=plan,
         )
         fut: asyncio.Future = self._loop.create_future()
 
@@ -1219,6 +1340,235 @@ class Verifyd:
             # disk OR the journal degraded while the job ran (the done
             # record is then also non-durable).
             reply["ok"]["durable"] = durable and not self._journal_writer.degraded
+        return reply
+
+    async def _follow(
+        self, req: dict, reader: asyncio.StreamReader | None = None
+    ) -> dict:
+        """One window of a followed stream: verify the delta against the
+        carried frontier and advance the durable frontier on OK.
+
+        The window verdict is **window-scoped** — it answers "is the
+        stream still linearizable given the committed prefix", not "is
+        this standalone history linearizable" — so it never enters the
+        verdict cache, the journal, or any router edge cache; the reply
+        carries ``scope="window"`` precisely so caches can refuse it.
+        A window with in-flight ops still gets a verdict, but the
+        frontier does not advance (``advanced=false``): the client
+        resends those events once their finishes arrive.
+        """
+        t_recv = self.tracer.now()
+        trace_id, _ = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
+        if self.prefix is None:
+            return err(
+                ERR_DECODE,
+                "follow needs the prefix store (start verifyd with --prefix)",
+            )
+        stream = str(req.get("stream") or "")
+        if not stream:
+            return err(ERR_DECODE, "follow needs a non-empty 'stream' id")
+        token = req.get("frontier")
+        entry = None
+        if token is not None:
+            token = str(token)
+            entry = self.prefix.get(token)
+            if entry is None:
+                # Evicted, never durable, or from another fleet member's
+                # store: the client resyncs by submitting the full history.
+                self.stats.emit(
+                    "prefix_refused",
+                    op="follow",
+                    reason="unknown_frontier",
+                    stream=stream,
+                    trace_id=trace_id,
+                )
+                return err(
+                    ERR_FRONTIER,
+                    f"frontier {token!r} is not in the store (evicted or "
+                    "never durable); resubmit the full history",
+                    frontier=token,
+                )
+        client = str(req.get("client") or "anon")
+        try:
+            priority = int(req.get("priority", 10))
+        except (TypeError, ValueError):
+            return err(
+                ERR_DECODE, f"priority must be an int, got {req.get('priority')!r}"
+            )
+        deadline = req.get("deadline")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                return err(
+                    ERR_DECODE, f"deadline must be a number, got {deadline!r}"
+                )
+        decoded = self._decode_history(
+            req.get("history"), req.get("records"), client
+        )
+        if isinstance(decoded, dict):
+            return decoded
+        _text, events, hist = decoded
+        try:
+            plan = plan_for_window(hist, token=token, entry=entry, stream=stream)
+        except ValueError as e:
+            return err(ERR_FRONTIER, str(e), frontier=token)
+        plan.total_events = len(events)
+        n = len(hist.ops)
+        if plan.refused:
+            self.stats.emit(
+                "prefix_refused",
+                op="follow",
+                reason=plan.refused,
+                stream=stream,
+                window=plan.window,
+                trace_id=trace_id,
+            )
+        if n == 0:
+            # An all-trivial window: nothing to search (trivial ops cannot
+            # change a verdict — checker/entries.py), so it is vacuously
+            # OK; the frontier re-keys at the same cut with the event
+            # horizon advanced, unless ops were left dangling.
+            advanced = False
+            if token is not None and not plan.refused:
+                new_entry = make_entry(
+                    plan.carry,
+                    events=plan.base_events + len(events),
+                    stream=stream,
+                    window=plan.window,
+                )
+                try:
+                    self.prefix.put(token, new_entry)
+                    advanced = True
+                except ValueError:
+                    log.exception("follow re-key refused for %r", token)
+            self.stats.emit(
+                "window_done",
+                stream=stream,
+                window=plan.window,
+                verdict="OK",
+                advanced=advanced,
+                ops_total=plan.base_ops,
+                trace_id=trace_id,
+            )
+            return ok(
+                {
+                    "verdict": "OK",
+                    "outcome": "OK",
+                    "backend": "frontier-trivial",
+                    "scope": "window",
+                    "stream": stream,
+                    "window": plan.window,
+                    "ops": 0,
+                    "ops_total": plan.base_ops,
+                    "frontier": token,
+                    "advanced": advanced,
+                    "trace_id": trace_id,
+                }
+            )
+        shape = shape_key(hist)
+        cancel = CancelToken(
+            time.monotonic() + deadline if deadline is not None else None
+        )
+        # The job "fingerprint" is the window's cut key (``pv2:...``) — a
+        # namespace the verdict cache never stores, so the scheduler's
+        # pre-start cache check always misses for window jobs.
+        fingerprint = plan.snap_keys.get(n) or f"pwindow:{stream}/{plan.window}"
+        job = Job(
+            id=next(self._job_ids),
+            client=client,
+            priority=priority,
+            shape=shape,
+            fingerprint=fingerprint,
+            events=events,
+            hist=hist,
+            no_viz=True,  # a window has no standalone witness to draw
+            trace_id=trace_id,
+            cancel=cancel,
+            prefix=plan,
+        )
+        fut: asyncio.Future = self._loop.create_future()
+
+        def _resolve(reply: dict) -> None:
+            def _finish() -> None:
+                if not fut.done():
+                    fut.set_result(reply)
+
+            with contextlib.suppress(RuntimeError):  # loop already closed
+                self._loop.call_soon_threadsafe(_finish)
+
+        job.resolve = _resolve
+        try:
+            depth = self.queue.put(job)
+        except QueueFull as e:
+            self.stats.emit(
+                "reject",
+                client=client,
+                priority=priority,
+                depth=e.depth,
+                retry_after_s=e.retry_after_s,
+            )
+            return err(
+                ERR_QUEUE_FULL,
+                str(e),
+                retry_after_s=e.retry_after_s,
+                depth=e.depth,
+            )
+        except RuntimeError as e:  # queue closed: daemon is stopping
+            return err(ERR_SHUTTING_DOWN, str(e))
+        job.enqueued_at = self.tracer.now()
+        self.stats.emit(
+            "admit",
+            job=job.id,
+            client=client,
+            priority=priority,
+            shape=job.shape,
+            depth=depth,
+            trace_id=trace_id,
+        )
+        self.stats.set_queue_depth(depth)
+        if self.tracer.enabled:
+            self.tracer.name_track(
+                job.id, f"follow {stream}#{plan.window} ({client})"
+            )
+            self.tracer.add_span(
+                "admit",
+                t_recv,
+                job.enqueued_at,
+                tid=job.id,
+                args={
+                    "client": client,
+                    "stream": stream,
+                    "window": plan.window,
+                    "trace_id": trace_id,
+                },
+            )
+        reply = await self._await_reply(fut, job, reader)
+        body = reply.get("ok")
+        if isinstance(body, dict):
+            new_key = plan.snap_keys.get(n)
+            # The frontier only advances when the worker actually stored
+            # the end-of-window snapshot (OK verdict, complete cut).
+            advanced = bool(new_key) and new_key in self.prefix
+            body.update(
+                stream=stream,
+                window=plan.window,
+                ops=n,
+                ops_total=plan.base_ops + n,
+                frontier=new_key if advanced else token,
+                advanced=advanced,
+            )
+            self.stats.emit(
+                "window_done",
+                stream=stream,
+                window=plan.window,
+                verdict=body.get("verdict"),
+                advanced=advanced,
+                ops_total=plan.base_ops + n,
+                trace_id=trace_id,
+            )
         return reply
 
     async def _await_reply(

@@ -34,6 +34,22 @@ Ops:
                 exit code 0/1/2, ``outcome``), the HTML artifact path,
                 the backend that decided, queue wait, and ``cached``
                 (answered from the verdict cache).
+``follow``    → one rolling window of a continuously monitored stream
+                (requires the daemon's prefix store, ``serve --prefix``).
+                Same history payload fields as ``submit`` (``history`` /
+                ``records``, ``client``, ``priority``, ``deadline``,
+                ``trace``) plus a required ``stream`` id and an optional
+                ``frontier`` — the store key of the previous window's
+                committed cut, echoed by the previous reply.  The reply
+                is **window-scoped** (``scope="window"``): the verdict
+                covers the stream-so-far given the committed prefix, not
+                the window as a standalone history, so it is never
+                cached.  It carries ``window`` (ordinal), ``ops`` /
+                ``ops_total``, ``advanced`` (whether the frontier moved)
+                and the new ``frontier`` token.  An unknown/evicted
+                ``frontier`` is refused with the **definite**
+                ``UnknownFrontier`` — the client resyncs by resubmitting
+                the full history (or restarting the follow from scratch).
 ``trace``     → ``{"ok": {"traceEvents": [...], ...}}`` — the daemon's
                 in-memory span ring in Chrome trace_event JSON (Object
                 Format); loads directly in Perfetto / chrome://tracing.
@@ -120,6 +136,7 @@ __all__ = [
     "ERR_SHUTTING_DOWN",
     "ERR_NO_BACKEND",
     "ERR_DEADLINE",
+    "ERR_FRONTIER",
     "ERR_QUARANTINED",
     "ERR_CANCELLED",
     "EXIT_BUSY",
@@ -162,6 +179,11 @@ ERR_QUARANTINED = "Quarantined"
 #: Definite: the job was cancelled for a non-deadline reason
 #: (``client_gone``, ``shutdown``) after admission.
 ERR_CANCELLED = "Cancelled"
+#: Definite: a ``follow`` frame named a frontier token the prefix store
+#: does not hold (evicted, never durable, or minted by another node).
+#: Retrying the same token is pointless — the client resyncs with a full
+#: ``submit`` and starts a fresh follow lineage.
+ERR_FRONTIER = "UnknownFrontier"
 #: Router-only: every routable backend was tried (or none existed) and
 #: the submit could not be placed.  Transient — clients retry like
 #: :data:`ERR_SHUTTING_DOWN`.
@@ -199,6 +221,18 @@ FRAME_FIELDS = {
         "no_viz": "optional",
         "deadline": "optional",
         "trace": "optional",
+    },
+    "follow": {
+        # Same one-of history/records contract as submit, plus the
+        # stream identity and the carried frontier token.
+        "history": "optional",
+        "records": "optional",
+        "client": "optional",
+        "priority": "optional",
+        "deadline": "optional",
+        "trace": "optional",
+        "stream": "optional",
+        "frontier": "optional",
     },
     "profiles": {
         "shape": "optional",

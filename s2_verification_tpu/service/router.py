@@ -69,8 +69,10 @@ from ..obs.httpd import MetricsServer
 from ..obs.metrics import LATENCY_BUCKETS, MetricsRegistry
 from ..obs.probe import CircuitBreaker, HealthProber, http_health_probe
 from ..obs.trace import Tracer
+from ..checker.prefix import closed_boundaries
 from ..utils import events as ev
 from .cache import history_fingerprint
+from .prefixstore import prefix_accumulators
 from .client import (
     VerifydBusy,
     VerifydClient,
@@ -312,10 +314,12 @@ class VerifydRouter:
         self._lock = threading.Lock()  # in-flight counters + steal choice
         self._seq = itertools.count(1)
         # Read-through edge cache (see RouterConfig.cache_capacity):
-        # raw-text digest -> fingerprint (skips prepare on duplicates),
-        # fingerprint -> decided reply payload (skips the backend hop).
+        # raw-text digest -> (fingerprint, affinity ring key) — skips
+        # prepare on duplicates; fingerprint -> decided reply payload —
+        # skips the backend hop.  Window-scoped (``follow``) verdicts are
+        # NEVER stored: they answer "stream-so-far", not "this history".
         self._cache_lock = threading.Lock()
-        self._text_fp: "OrderedDict[bytes, str]" = OrderedDict()
+        self._text_fp: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._verdicts: "OrderedDict[str, dict]" = OrderedDict()
 
         r = self.registry
@@ -681,6 +685,10 @@ class VerifydRouter:
                 return await self._loop.run_in_executor(
                     self._pool, functools.partial(self._route_submit, req)
                 )
+            if op == "follow":
+                return await self._loop.run_in_executor(
+                    self._pool, functools.partial(self._route_follow, req)
+                )
             return err(ERR_DECODE, f"unknown op {op!r}")
         except Exception as e:  # handler must never kill the loop
             log.exception("router dispatch failed for op %r", op)
@@ -706,7 +714,8 @@ class VerifydRouter:
         if not isinstance(text, str) or not text:
             return None
         with self._cache_lock:
-            fp = self._text_fp.get(self._text_key(text))
+            memo = self._text_fp.get(self._text_key(text))
+            fp = memo[0] if memo is not None else None
             payload = self._verdicts.get(fp) if fp is not None else None
             if payload is None:
                 return None
@@ -723,11 +732,22 @@ class VerifydRouter:
         self.health.observe_event({"ev": "cache_hit", "queue_wait_s": 0.0})
         return ok(reply)
 
-    def _cache_store(self, key: bytes, fingerprint: str, reply: dict) -> None:
+    def _cache_store(
+        self, key: bytes, fingerprint: str, affinity: str, reply: dict
+    ) -> None:
         """Remember a decided reply (daemon rule: unknowns are never
-        cached — a resubmission deserves a fresh run)."""
+        cached — a resubmission deserves a fresh run).
+
+        Window-scoped replies are refused outright: a ``follow`` (or any
+        prefix-window) verdict covers the *stream so far given the
+        committed prefix* — fingerprint-global reuse of it would answer a
+        later full-history submit with a rolling verdict that never
+        examined that history standalone.
+        """
         cap = self.cfg.cache_capacity
         if cap <= 0:
+            return
+        if reply.get("scope") == "window":
             return
         if reply.get("verdict") not in (0, 1):
             return
@@ -737,7 +757,7 @@ class VerifydRouter:
             if k not in ("trace_id", "queue_wait_s", "stolen")
         }
         with self._cache_lock:
-            self._text_fp[key] = fingerprint
+            self._text_fp[key] = (fingerprint, affinity)
             self._text_fp.move_to_end(key)
             while len(self._text_fp) > cap:
                 self._text_fp.popitem(last=False)
@@ -748,7 +768,30 @@ class VerifydRouter:
 
     # -- routing core (runs on the executor, blocking clients) ---------------
 
-    def _candidate_order(self, fingerprint: str) -> Tuple[List[_Backend], bool]:
+    @staticmethod
+    def _affinity_key(hist, fingerprint: str) -> str:
+        """Ring placement key for a prepared history.
+
+        The verdict fingerprint changes whenever a single op is
+        appended, so fingerprint-keyed placement scatters a growing
+        stream's resubmissions across the fleet — every extension lands
+        cold, away from the node holding its prefix snapshots.  Keying
+        the ring by the chain-hash accumulator at the history's *first*
+        closed boundary is stable under extension (appended ops only
+        deepen the suffix), so the whole lineage — and its ``follow``
+        windows, which reuse the same chain-hash namespace — homes on
+        one node.  Identical texts still collide (same first boundary),
+        preserving verdict-cache affinity.  Histories with no closed
+        boundary short of the end fall back to the fingerprint.
+        """
+        bounds = closed_boundaries(hist)
+        cuts = [k for k in bounds if k < len(hist.ops)]
+        if not cuts:
+            return fingerprint
+        keys = prefix_accumulators(hist, [cuts[0]])
+        return keys.get(cuts[0], fingerprint)
+
+    def _candidate_order(self, affinity: str) -> Tuple[List[_Backend], bool]:
         """(ordered attempt list, stolen?) for one job.
 
         Ring preference first; when the home node is saturated, the
@@ -758,7 +801,7 @@ class VerifydRouter:
         """
         prefs = [
             self._backends[n]
-            for n in self.ring.preference(fingerprint)
+            for n in self.ring.preference(affinity)
             if n in self._backends
         ]
         order = [b for b in prefs if b.routable()]
@@ -775,6 +818,58 @@ class VerifydRouter:
                     stolen = True
         return order, stolen
 
+    # -- per-attempt bookkeeping shared by the submit and follow routes ------
+
+    def _attempt_begin(self, b: _Backend) -> None:
+        with self._lock:
+            b.in_flight += 1
+            self._m_inflight.set(b.in_flight, backend=b.name)
+
+    def _attempt_end(self, b: _Backend) -> None:
+        with self._lock:
+            b.in_flight = max(0, b.in_flight - 1)
+            self._m_inflight.set(b.in_flight, backend=b.name)
+
+    def _note_busy(self, b: _Backend, e: VerifydBusy) -> None:
+        # The node answered: alive, just saturated.
+        b.breaker.record_success()
+        b.last_retry_after = e.retry_after_s
+        self._bump("busy")
+        self._m_busy.inc(backend=b.name)
+
+    def _note_failover(self, b: _Backend, e, t0: float, seq: int, trace_id: str) -> str:
+        b.breaker.record_failure()
+        b.last_error = f"{e.cls}: {e.msg}"[:200]
+        self._refresh_breaker_gauge(b)
+        self._bump("failovers")
+        self._m_failovers.inc(backend=b.name)
+        self.tracer.add_span(
+            "failover",
+            t0,
+            self.tracer.now(),
+            tid=seq,
+            cat="router",
+            args={"trace_id": trace_id, "node": b.name, "error": e.cls},
+        )
+        return b.last_error
+
+    def _note_draining(self, b: _Backend, e) -> str:
+        # Draining underneath us: keep it out of the set until the
+        # prober sees the restart.
+        b.draining = True
+        self._m_draining.set(1, backend=b.name)
+        return f"{e.cls}: {e.msg}"[:200]
+
+    def _note_routed(self, b: _Backend, dt: float, trace_id: str) -> None:
+        b.breaker.record_success()
+        self._refresh_breaker_gauge(b)
+        self._bump("routed")
+        self._m_routed.inc(backend=b.name)
+        self._m_latency.observe(dt, exemplar=trace_id, backend=b.name)
+        self.health.observe_event(
+            {"ev": "done", "wall_s": dt, "queue_wait_s": 0.0}
+        )
+
     def _route_submit(self, req: dict) -> dict:
         t_recv = self.tracer.now()
         self._m_jobs.inc()
@@ -788,15 +883,16 @@ class VerifydRouter:
             return err(
                 ERR_DECODE, "submit needs a non-empty 'history' JSONL string"
             )
-        # The router prepares the history itself: the fingerprint *is*
-        # the routing key (cache affinity), and an undecodable history
-        # is answered here — no backend burns a slot on it.  A text seen
-        # before (even one whose verdict wasn't cacheable) maps straight
-        # to its fingerprint without re-preparing.
+        # The router prepares the history itself: the fingerprint keys
+        # the verdict cache, the affinity key places the job on the
+        # ring, and an undecodable history is answered here — no backend
+        # burns a slot on it.  A text seen before (even one whose
+        # verdict wasn't cacheable) maps straight to both without
+        # re-preparing.
         text_key = self._text_key(text)
         with self._cache_lock:
-            fingerprint = self._text_fp.get(text_key)
-        if fingerprint is None:
+            memo = self._text_fp.get(text_key)
+        if memo is None:
             try:
                 hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
             except (ev.DecodeError, ValueError) as e:
@@ -804,11 +900,14 @@ class VerifydRouter:
                 self._m_decode.inc()
                 return err(ERR_DECODE, str(e))
             fingerprint = history_fingerprint(hist)
+            affinity = self._affinity_key(hist, fingerprint)
             if self.cfg.cache_capacity > 0:
                 with self._cache_lock:
-                    self._text_fp[text_key] = fingerprint
+                    self._text_fp[text_key] = (fingerprint, affinity)
                     while len(self._text_fp) > self.cfg.cache_capacity:
                         self._text_fp.popitem(last=False)
+        else:
+            fingerprint, affinity = memo
 
         # End-to-end deadline: the client's remaining budget rides the
         # frame; the router decrements it across failovers so a job that
@@ -826,7 +925,7 @@ class VerifydRouter:
                 )
         t_deadline0 = time.monotonic()
 
-        order, stolen = self._candidate_order(fingerprint)
+        order, stolen = self._candidate_order(affinity)
         limit = 1 + max(0, self.cfg.max_failovers)
         attempts = 0
         last_busy: Optional[VerifydBusy] = None
@@ -851,9 +950,7 @@ class VerifydRouter:
                 self._refresh_breaker_gauge(b)
                 continue
             attempts += 1
-            with self._lock:
-                b.in_flight += 1
-                self._m_inflight.set(b.in_flight, backend=b.name)
+            self._attempt_begin(b)
             t0 = self.tracer.now()
             try:
                 reply = b.client.submit(
@@ -872,29 +969,12 @@ class VerifydRouter:
                     deadline_s=remaining,
                 )
             except VerifydBusy as e:
-                # The node answered: alive, just saturated — steal the
-                # job onward and remember the hint for the client.
-                b.breaker.record_success()
-                b.last_retry_after = e.retry_after_s
+                # Saturated — steal the job onward, remember the hint.
+                self._note_busy(b, e)
                 last_busy = e
-                self._bump("busy")
-                self._m_busy.inc(backend=b.name)
                 continue
             except (VerifydUnavailable, VerifydRefused) as e:
-                b.breaker.record_failure()
-                b.last_error = f"{e.cls}: {e.msg}"[:200]
-                self._refresh_breaker_gauge(b)
-                self._bump("failovers")
-                self._m_failovers.inc(backend=b.name)
-                last_err = b.last_error
-                self.tracer.add_span(
-                    "failover",
-                    t0,
-                    self.tracer.now(),
-                    tid=seq,
-                    cat="router",
-                    args={"trace_id": trace_id, "node": b.name, "error": e.cls},
-                )
+                last_err = self._note_failover(b, e, t0, seq, trace_id)
                 continue
             except VerifydError as e:
                 # A semantic answer (DecodeError, InternalError,
@@ -903,11 +983,7 @@ class VerifydRouter:
                 # decided — pass it through, never fail it over.
                 b.breaker.record_success()
                 if e.cls == ERR_SHUTTING_DOWN:
-                    # Draining underneath us: keep it out of the set
-                    # until the prober sees the restart.
-                    b.draining = True
-                    self._m_draining.set(1, backend=b.name)
-                    last_err = f"{e.cls}: {e.msg}"[:200]
+                    last_err = self._note_draining(b, e)
                     continue
                 self.health.observe_event({"ev": "job_error"})
                 return err(e.cls, e.msg, **{
@@ -916,23 +992,14 @@ class VerifydRouter:
                     if k not in ("class", "msg")
                 })
             finally:
-                with self._lock:
-                    b.in_flight = max(0, b.in_flight - 1)
-                    self._m_inflight.set(b.in_flight, backend=b.name)
+                self._attempt_end(b)
 
             t1 = self.tracer.now()
             dt = t1 - t0
-            b.breaker.record_success()
-            self._refresh_breaker_gauge(b)
-            self._bump("routed")
+            self._note_routed(b, dt, trace_id)
             if stolen and attempts == 1:
                 self._bump("stolen")
                 self._m_stolen.inc(backend=b.name)
-            self._m_routed.inc(backend=b.name)
-            self._m_latency.observe(dt, exemplar=trace_id, backend=b.name)
-            self.health.observe_event(
-                {"ev": "done", "wall_s": dt, "queue_wait_s": 0.0}
-            )
             if self.tracer.enabled:
                 self.tracer.name_track(seq, f"route {seq}")
                 self.tracer.add_span(
@@ -954,12 +1021,169 @@ class VerifydRouter:
             reply.setdefault("trace_id", trace_id)
             if stolen and attempts == 1:
                 reply["stolen"] = True
-            self._cache_store(text_key, fingerprint, reply)
+            self._cache_store(text_key, fingerprint, affinity, reply)
             return ok(reply)
 
         if last_busy is not None:
             # Every routable node is saturated: propagate backpressure
             # with the smallest live hint so clients sleep the minimum.
+            hints = [
+                b.last_retry_after
+                for b in order
+                if b.last_retry_after > 0
+            ] or [last_busy.retry_after_s]
+            self.health.observe_event({"ev": "reject"})
+            return err(
+                ERR_QUEUE_FULL,
+                f"all {attempts} routable backends at capacity",
+                retry_after_s=min(hints),
+            )
+        self._bump("no_backend")
+        self._m_no_backend.inc()
+        self.health.observe_event({"ev": "job_error"})
+        return err(
+            ERR_NO_BACKEND,
+            f"no backend answered after {attempts} attempts ({last_err})",
+            attempts=attempts,
+        )
+
+    def _route_follow(self, req: dict) -> dict:
+        """Route one ``follow`` window by stream affinity.
+
+        Frontier tokens name entries in ONE node's prefix store, so
+        every window of a lineage must land on the same backend: the
+        ring is keyed by the stream id, work-stealing is off (a stolen
+        window is guaranteed cold), and the edge cache is bypassed both
+        ways — window verdicts are never stored, and a cached
+        full-history verdict must never answer a rolling window.  A
+        failover hop is still sound: the next node answers the definite
+        ``UnknownFrontier`` and the client resyncs with a full submit.
+        """
+        t_recv = self.tracer.now()
+        self._m_jobs.inc()
+        trace_id, _sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
+        stream = req.get("stream")
+        if not isinstance(stream, str) or not stream:
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(ERR_DECODE, "follow needs a non-empty 'stream' id")
+        records = req.get("records")
+        text = req.get("history") if records is None else None
+        if records is None and not isinstance(text, str):
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(
+                ERR_DECODE, "follow needs 'history' JSONL or 'records'"
+            )
+        deadline = req.get("deadline")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                self._bump("decode_errors")
+                self._m_decode.inc()
+                return err(
+                    ERR_DECODE, f"deadline must be a number, got {deadline!r}"
+                )
+        t_deadline0 = time.monotonic()
+
+        order = [
+            self._backends[n]
+            for n in self.ring.preference(f"stream:{stream}")
+            if n in self._backends and self._backends[n].routable()
+        ]
+        limit = 1 + max(0, self.cfg.max_failovers)
+        attempts = 0
+        last_busy: Optional[VerifydBusy] = None
+        last_err = "no routable backend"
+        seq = next(self._seq)
+        for b in order:
+            if attempts >= limit:
+                break
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - (time.monotonic() - t_deadline0)
+                if remaining <= 0:
+                    self.health.observe_event({"ev": "job_error"})
+                    return err(
+                        ERR_DEADLINE,
+                        f"deadline spent after {attempts} attempt(s) "
+                        f"({last_err})",
+                        attempts=attempts,
+                        reason="deadline",
+                    )
+            if not b.breaker.allow():
+                self._refresh_breaker_gauge(b)
+                continue
+            attempts += 1
+            self._attempt_begin(b)
+            t0 = self.tracer.now()
+            try:
+                reply = b.client.follow(
+                    text,
+                    records=records,
+                    stream=stream,
+                    frontier=req.get("frontier"),
+                    client=str(req.get("client") or "router"),
+                    priority=int(req.get("priority") or 10),
+                    timeout=(
+                        self.cfg.submit_timeout_s
+                        if remaining is None
+                        else min(
+                            self.cfg.submit_timeout_s or remaining, remaining
+                        )
+                    ),
+                    trace_id=trace_id,
+                    deadline_s=remaining,
+                )
+            except VerifydBusy as e:
+                self._note_busy(b, e)
+                last_busy = e
+                continue
+            except (VerifydUnavailable, VerifydRefused) as e:
+                last_err = self._note_failover(b, e, t0, seq, trace_id)
+                continue
+            except VerifydError as e:
+                # Semantic answers — including UnknownFrontier — pass
+                # through: the daemon decided, the client resyncs.
+                b.breaker.record_success()
+                if e.cls == ERR_SHUTTING_DOWN:
+                    last_err = self._note_draining(b, e)
+                    continue
+                self.health.observe_event({"ev": "job_error"})
+                return err(e.cls, e.msg, **{
+                    k: v
+                    for k, v in e.extra.items()
+                    if k not in ("class", "msg")
+                })
+            finally:
+                self._attempt_end(b)
+
+            t1 = self.tracer.now()
+            dt = t1 - t0
+            self._note_routed(b, dt, trace_id)
+            if self.tracer.enabled:
+                self.tracer.name_track(seq, f"route {seq}")
+                self.tracer.add_span(
+                    "route.follow",
+                    t_recv,
+                    t1,
+                    tid=seq,
+                    cat="router",
+                    args={
+                        "trace_id": trace_id,
+                        "node": b.name,
+                        "stream": stream,
+                        "attempts": attempts,
+                    },
+                )
+            reply["node"] = b.name
+            reply.setdefault("trace_id", trace_id)
+            return ok(reply)
+
+        if last_busy is not None:
             hints = [
                 b.last_retry_after
                 for b in order

@@ -119,6 +119,7 @@ class Scheduler:
         cancel_grace_s: float = 2.0,
         batching: bool = False,
         batch_engine: str = "auto",
+        prefix_store=None,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -159,6 +160,10 @@ class Scheduler:
         #: as one mega-launch (service/batcher.py) instead of job by job
         self.batching = batching
         self.batch_engine = batch_engine
+        #: prefix store (service/prefixstore.PrefixStore); jobs carrying a
+        #: PrefixPlan run the resumable host-frontier path and write their
+        #: snapshot cuts here on OK
+        self.prefix_store = prefix_store
         self._batcher = None
         if batching:
             from .batcher import Batcher
@@ -194,10 +199,20 @@ class Scheduler:
                 continue
             self.stats.set_queue_depth(len(self.queue))
             if self._batcher is not None and len(batch) > 1:
-                # Mega-launch: the whole shape group (plus late-joiners)
-                # in one batched search; the batcher resolves every job.
-                self._batcher.run_group(batch)
-                continue
+                # Prefix-planned jobs peel off before a mega-launch: the
+                # batched engines search cold from op 0, which is wrong
+                # for window-scoped follow jobs (their carry IS the
+                # prefix) and wastes the resume for extensions.
+                grouped = [j for j in batch if j.prefix is None]
+                batch = [j for j in batch if j.prefix is not None]
+                if len(grouped) > 1:
+                    # Mega-launch: the whole shape group (plus
+                    # late-joiners); the batcher resolves every job.
+                    self._batcher.run_group(grouped)
+                else:
+                    batch = grouped + batch
+                if not batch:
+                    continue
             for job in batch:
                 try:
                     reply = self._run_job(job)
@@ -228,8 +243,16 @@ class Scheduler:
         except (OSError, ValueError):
             log.exception("job %d: journal append failed", job.id)
 
+    @staticmethod
+    def _is_window(job: Job) -> bool:
+        """Follow-window jobs: verdicts are window-scoped (computed from a
+        carried frontier, not op 0), so they must never enter the verdict
+        cache or the journal — a replay or a fingerprint twin would serve
+        a rolling verdict as if it were a cold full-history one."""
+        return job.prefix is not None and job.prefix.kind == "window"
+
     def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
-        if self.journal is None:
+        if self.journal is None or self._is_window(job):
             return
         self._journal_append(
             job,
@@ -316,7 +339,7 @@ class Scheduler:
         # Run record before the search: it is what lets boot-time orphan
         # recovery distinguish a poison job (started, then the process
         # died) from one that innocently sat in the queue.
-        if self.journal is not None:
+        if self.journal is not None and not self._is_window(job):
             self._journal_append(
                 job,
                 lambda: self.journal.started(
@@ -424,9 +447,15 @@ class Scheduler:
         profile = job_profile(res) if self.profile else None
         if profile is not None:
             payload["profile"] = profile
+        if self._is_window(job):
+            # A follow window's verdict only covers the suffix relative to
+            # its carry; its "fingerprint" is the cut key (pv2:...), and
+            # the payload is marked so edges scope it too.
+            payload["scope"] = "window"
         # Inconclusive verdicts are not cached: a resubmission may get a
         # healthier device or a bigger budget and deserves a fresh run.
-        if res.outcome != CheckOutcome.UNKNOWN:
+        # Window verdicts are never cached at all (see _is_window).
+        if res.outcome != CheckOutcome.UNKNOWN and not self._is_window(job):
             self.cache.put(job.fingerprint, payload)
         # Done-mark after the cache put: a crash in between re-runs the
         # job (at-least-once), and the rerun answers from the cache.
@@ -482,7 +511,10 @@ class Scheduler:
         if job.cancel.check() is not None:
             # Cancelled during the CPU stage: skip device escalation.
             return res, engine
-        if self.device != "off":
+        if self.device != "off" and not self._is_window(job):
+            # (Window jobs never escalate: the device engines search cold
+            # from op 0, and a window without its carry is a different —
+            # wrong — question.)
             t_dev = time.monotonic()
             dres, dev_backend = self._escalate_device(job)
             t_end = time.monotonic()
@@ -511,9 +543,110 @@ class Scheduler:
             return res, f"{engine}-unbounded"
         return res, engine
 
+    def _traced_prefix(
+        self, job: Job, budget: float | None
+    ) -> tuple[CheckResult, str]:
+        """Resumable host-frontier search for prefix-planned jobs.
+
+        Runs :func:`..checker.frontier.check_frontier_auto` with the
+        plan's carry as the initial configuration and its chosen cuts as
+        snapshot points; on OK the completed cuts are written to the
+        prefix store.  The span name distinguishes ``search.resume``
+        (carry present) from ``search.cold`` (probe missed; this search
+        merely seeds the store).
+        """
+        from ..checker.frontier import check_frontier_auto
+
+        plan = job.prefix
+        init_counts = init_states = None
+        if plan.carry is not None:
+            init_states = plan.carry.states
+            if plan.kind == "extend":
+                init_counts = plan.resume_counts
+        mode = "resume" if plan.carry is not None else "cold"
+        t0 = time.monotonic()
+        res = check_frontier_auto(
+            job.hist,
+            collect_stats=True,
+            witness=False,
+            profile=self.profile,
+            init_counts=init_counts,
+            init_states=init_states,
+            snapshot_cuts=sorted(plan.snap_keys) or None,
+            time_budget_s=budget,
+        )
+        self.tracer.add_span(
+            f"search.{mode}",
+            t0,
+            time.monotonic(),
+            tid=job.id,
+            args={
+                "budget_s": budget,
+                "outcome": res.outcome.value,
+                "kind": plan.kind,
+                "resume_ops": plan.resume_ops,
+                "ops": len(job.hist.ops),
+                "trace_id": job.trace_id,
+            },
+        )
+        self._store_snapshots(job, res)
+        return res, f"frontier-{mode}"
+
+    def _store_snapshots(self, job: Job, res: CheckResult) -> None:
+        """Write every completed snapshot cut of an OK search to the
+        prefix store (checker/frontier.py already refused cuts touched by
+        pruning or crossed by in-flight ops)."""
+        plan = job.prefix
+        if (
+            self.prefix_store is None
+            or plan is None
+            or not plan.snap_keys
+            or res.outcome != CheckOutcome.OK
+        ):
+            return
+        snaps = getattr(res, "snapshots", None) or {}
+        from ..checker.prefix import PrefixCarry
+        from .prefixstore import make_entry
+
+        n = len(job.hist.ops)
+        for k, states in snaps.items():
+            key = plan.snap_keys.get(k)
+            if key is None:
+                continue
+            # Event horizon of the cut: the first suffix event (or the
+            # whole window) — the offset a follow continuation folds from.
+            horizon = plan.base_events + (
+                job.hist.ops[k].call if k < n else plan.total_events
+            )
+            carry = PrefixCarry(ops=plan.base_ops + k, states=tuple(states))
+            try:
+                self.prefix_store.put(
+                    key,
+                    make_entry(
+                        carry,
+                        events=horizon,
+                        stream=plan.stream,
+                        window=plan.window,
+                    ),
+                )
+            except ValueError:
+                log.warning("job %d: refused snapshot at cut %d", job.id, k)
+                continue
+            self.stats.emit(
+                "prefix_snapshot",
+                job=job.id,
+                key=key,
+                ops=plan.base_ops + k,
+                entries=len(self.prefix_store),
+                bytes=self.prefix_store.bytes,
+                trace_id=job.trace_id,
+            )
+
     def _traced_cpu(
         self, job: Job, budget: float | None
     ) -> tuple[CheckResult, str]:
+        if job.prefix is not None:
+            return self._traced_prefix(job, budget)
         t0 = time.monotonic()
         # profile only when asked: test doubles for _cpu_check keep the
         # plain (hist, budget) signature.

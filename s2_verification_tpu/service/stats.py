@@ -38,6 +38,16 @@ batching (ISSUE 15): ``batch_launch`` — one mega-launch of a shape group
 ``early_exits``, ``occupancy`` = lanes over ``batch_max``, ``late_join``,
 ``wall_s`` = the launch wall; per-job attribution stays on each lane's
 own ``done`` event).
+Incremental prefix verification (ISSUE 16): ``prefix_loaded``
+(persisted frontier snapshots replayed at boot), ``prefix_hit`` /
+``prefix_miss`` (an admission probe found / missed a cached prefix;
+hits carry ``resume_ops`` and ``depth_frac`` = resumed fraction of the
+history), ``prefix_snapshot`` (a worker persisted one cut's carried
+frontier; carries the store's ``entries``/``bytes`` after the put),
+``prefix_refused`` (a snapshot or frontier advance was refused:
+``reason`` = open_ops / unknown_frontier), and ``window_done`` (one
+``follow`` window answered: ``stream``, ``window`` ordinal,
+``verdict``, ``advanced``, cumulative ``ops_total``).
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -139,6 +149,12 @@ class ServiceStats:
             "batch_launches": 0,
             "batch_lanes": 0,
             "batch_early_exits": 0,
+            "prefix_hits": 0,
+            "prefix_misses": 0,
+            "prefix_snapshots": 0,
+            "prefix_refused": 0,
+            "prefix_loaded": 0,
+            "windows_done": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -324,6 +340,42 @@ class ServiceStats:
         self._m_batch_occupancy = r.gauge(
             "verifyd_batch_launch_occupancy_ratio",
             "Lanes over batch_max for the most recent mega-launch",
+        )
+        # Incremental prefix verification (ISSUE 16).  The refused-reason
+        # label is the closed {open_ops, unknown_frontier} vocabulary.
+        self._m_prefix_hits = r.counter(
+            "verifyd_prefix_hits_total",
+            "Admission probes that found a cached prefix to resume from",
+        )
+        self._m_prefix_misses = r.counter(
+            "verifyd_prefix_misses_total",
+            "Admission probes that found no cached prefix (cold search)",
+        )
+        self._m_prefix_snapshots = r.counter(
+            "verifyd_prefix_snapshots_total",
+            "Frontier snapshots persisted at prefix-closed cuts",
+        )
+        self._m_prefix_refused = r.counter(
+            "verifyd_prefix_refused_total",
+            "Snapshots or frontier advances refused for soundness",
+            labelnames=("reason",),
+        )
+        self._m_prefix_entries = r.gauge(
+            "verifyd_prefix_store_entries", "Frontier snapshots held in the store"
+        )
+        self._m_prefix_bytes = r.gauge(
+            "verifyd_prefix_store_bytes",
+            "Serialized bytes of the in-memory prefix store",
+        )
+        self._m_prefix_depth = r.histogram(
+            "verifyd_prefix_resume_depth_ratio",
+            "Resumed fraction of the history on a prefix hit (1.0 = the "
+            "whole committed prefix was cached)",
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 1.0),
+        )
+        self._m_windows = r.counter(
+            "verifyd_follow_windows_total",
+            "Follow windows answered with a window-scoped verdict",
         )
         # Resource telemetry (obs/introspect.ResourceSampler sets these).
         self._m_res_rss = r.gauge(
@@ -551,6 +603,36 @@ class ServiceStats:
             if early:
                 self._m_batch_early.inc(early)
             self._m_batch_occupancy.set(float(fields.get("occupancy", 0.0)))
+        elif event == "prefix_loaded":
+            n = int(fields.get("entries", 0))
+            self._counters["prefix_loaded"] += n
+            self._m_prefix_entries.set(n)
+            self._m_prefix_bytes.set(int(fields.get("bytes", 0)))
+        elif event == "prefix_hit":
+            self._counters["prefix_hits"] += 1
+            self._m_prefix_hits.inc()
+            if "depth_frac" in fields:
+                self._m_prefix_depth.observe(
+                    float(fields["depth_frac"]),
+                    exemplar=fields.get("trace_id"),
+                )
+        elif event == "prefix_miss":
+            self._counters["prefix_misses"] += 1
+            self._m_prefix_misses.inc()
+        elif event == "prefix_snapshot":
+            self._counters["prefix_snapshots"] += 1
+            self._m_prefix_snapshots.inc()
+            self._m_prefix_entries.set(int(fields.get("entries", 0)))
+            self._m_prefix_bytes.set(int(fields.get("bytes", 0)))
+        elif event == "prefix_refused":
+            self._counters["prefix_refused"] += 1
+            reason = str(fields.get("reason", "other"))
+            if reason not in ("open_ops", "unknown_frontier"):
+                reason = "other"
+            self._m_prefix_refused.inc(reason=reason)
+        elif event == "window_done":
+            self._counters["windows_done"] += 1
+            self._m_windows.inc()
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)
@@ -582,6 +664,10 @@ class ServiceStats:
                 backend = "device-mesh"
             elif backend.startswith("device"):
                 backend = "device"
+            elif backend.startswith("frontier"):
+                # frontier-cold / frontier-resume / frontier-unbounded:
+                # one engine family, one timeseries.
+                backend = "frontier"
             if backend not in (
                 "native",
                 "oracle",

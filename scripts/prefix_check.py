@@ -1,0 +1,391 @@
+"""Incremental-verification gate: prove the prefix store end to end.
+
+Three phases, each a hard assertion (the `make prefix` gate):
+
+1. **Crash recovery** — a *subprocess* daemon with ``--prefix
+   --state-dir`` follows a stream for several windows, then is
+   SIGKILLed while a window is in flight.  A reboot on the same state
+   dir replays the segment log (torn tail and all), the last committed
+   frontier token still resolves, and the next window resumes warm
+   (``frontier-resume``).  ``read_cold`` — the doctor's view — must
+   agree with what the lineage committed.
+2. **Warm/cold wall gate** — the ISSUE acceptance number: after a 10%
+   append to an already-verified ~4000-op stream, warm re-verification
+   wall must be ≤ 25% of the cold wall (median of 3 distinct same-size
+   histories), with the identical verdict.
+3. **Verdict parity** — every campaign violation class plus legal
+   shapes through a prefix-warmed daemon and a prefix-less daemon:
+   verdicts and outcomes must be byte-identical.
+
+Exit 0 when every assertion holds; 1 with the failures on stderr.
+One JSON summary line lands on stdout.
+
+Usage:
+    python scripts/prefix_check.py [--ratio 0.25] [--ops 4000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from s2_verification_tpu.collector.campaign import (
+    Campaign,
+    CampaignPhase,
+    collect_labeled,
+)
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.prefixstore import read_cold
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold  # tests/helpers.py: the history builder
+
+_QUIET = FaultPlan(min_latency=0.001, max_latency=0.003)
+
+VIOLATIONS = (
+    ("drop_acked", "regular"),
+    ("reorder", "regular"),
+    ("stale_read", "regular"),
+    ("fence_resurrect", "fencing"),
+)
+
+
+def _fail(msg: str) -> str:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return msg
+
+
+def _serial_lines(n_ops: int, seed: int = 0) -> list[str]:
+    """A serial all-OK stream, 2 JSONL lines per op: every op boundary
+    is a closed cut, so any even line split is a legal window edge."""
+    h = H()
+    hashes: list[int] = []
+    for k in range(n_ops):
+        if k % 2 == 0:
+            hashes.append(1_000_003 * (seed + 1) + k)
+            h.append_ok(1, [hashes[-1]], tail=len(hashes))
+        else:
+            h.read_ok(1, tail=len(hashes), stream_hash=fold(hashes))
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+
+
+def _join(lines: list[str]) -> str:
+    return "\n".join(lines) + "\n"
+
+
+def _child_env() -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + (
+        (os.pathsep + env["PYTHONPATH"]) if env.get("PYTHONPATH") else ""
+    )
+    return env
+
+
+def _spawn_daemon(sock: str, state: str, tmp: str):
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "s2_verification_tpu", "serve",
+            "-socket", sock,
+            "--workers", "1",
+            "-no-viz",
+            "--prefix",
+            "--state-dir", state,
+            "--stats-log", "",
+            "-out-dir", os.path.join(tmp, "viz"),
+        ],
+        env=_child_env(),
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    while not os.path.exists(sock):
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode} at boot")
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError("daemon socket never appeared")
+        time.sleep(0.05)
+    return proc
+
+
+def _sigkill(proc, sock: str) -> None:
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.wait()
+    if os.path.exists(sock):
+        os.remove(sock)  # SIGKILL leaves the file; serve refuses a stale one
+
+
+# -- phase 1: SIGKILL mid-follow, reboot, resume ------------------------------
+
+
+def phase_crash_recovery(tmp: str, failures: list) -> dict:
+    lines = _serial_lines(600)  # 1200 lines; 100-line windows = 50 ops each
+    sock = os.path.join(tmp, "p1.sock")
+    state = os.path.join(tmp, "p1-state")
+    proc = _spawn_daemon(sock, state, tmp)
+    client = VerifydClient(sock, timeout=120)
+    token = None
+    committed_ops = 0
+    try:
+        for w in range(4):
+            lo, hi = w * 100, (w + 1) * 100
+            r = client.follow(
+                _join(lines[lo:hi]), stream="orders", frontier=token
+            )
+            if r["verdict"] != 0 or not r["advanced"]:
+                failures.append(
+                    _fail(f"phase1 window {w}: verdict={r['verdict']} "
+                          f"advanced={r['advanced']}")
+                )
+                return {}
+            token = r["frontier"]
+            committed_ops = r["ops_total"]
+
+        # Kill the daemon while the next window is in flight: the client
+        # thread eats a transport error, the store keeps only what the
+        # committed lineage spilled.
+        def _doomed():
+            try:
+                VerifydClient(sock, timeout=30).follow(
+                    _join(lines[400:1200]), stream="orders", frontier=token
+                )
+            except Exception:
+                pass  # expected: the daemon dies underneath
+
+        t = threading.Thread(target=_doomed, daemon=True)
+        t.start()
+        time.sleep(0.05)  # enough for admission, not for the whole search
+        _sigkill(proc, sock)
+        proc = None
+        t.join(timeout=30)
+
+        cold = read_cold(state)
+        if cold is None or cold["entries"] < 1:
+            failures.append(_fail("phase1: read_cold found no prefix log"))
+            return {}
+        # The kill races the in-flight window: it either died mid-search
+        # (store holds exactly what we saw committed) or committed just
+        # before the signal landed (store is deeper).  Both are sound;
+        # a *shallower* store would mean a durable commit was lost.
+        stream_view = cold["streams"].get("orders")
+        if not stream_view or stream_view["ops"] < committed_ops:
+            failures.append(
+                _fail(f"phase1: doctor sees {stream_view} but the lineage "
+                      f"committed {committed_ops} ops")
+            )
+
+        proc = _spawn_daemon(sock, state, tmp)
+        client = VerifydClient(sock, timeout=120)
+        r = client.follow(
+            _join(lines[400:500]), stream="orders", frontier=token
+        )
+        if r["verdict"] != 0 or not r["backend"].startswith("frontier-resume"):
+            failures.append(
+                _fail(f"phase1 post-reboot: backend={r['backend']} "
+                      f"verdict={r['verdict']} (expected a warm resume)")
+            )
+        if r["ops_total"] != committed_ops + 50:
+            failures.append(
+                _fail(f"phase1 post-reboot: ops_total={r['ops_total']}")
+            )
+        return {
+            "windows_before_kill": 4,
+            "committed_ops": committed_ops,
+            "recovered_entries": cold["entries"],
+            "resumed_backend": r["backend"],
+        }
+    finally:
+        if proc is not None and proc.poll() is None:
+            try:
+                VerifydClient(sock, timeout=10).shutdown()
+                proc.wait(timeout=30)
+            except Exception:
+                proc.kill()
+
+
+# -- phase 2: the 25% warm-wall acceptance gate -------------------------------
+
+
+def phase_wall_gate(tmp: str, failures: list, *, ops: int, ratio: float) -> dict:
+    base = _serial_lines(ops)
+    extended = _serial_lines(ops + ops // 10)
+    cfg = VerifydConfig(
+        socket_path=os.path.join(tmp, "p2.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=60.0,
+        out_dir=os.path.join(tmp, "p2-viz"),
+        no_viz=True,
+        prefix_enabled=True,
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=300)
+        # Cold baseline: median over distinct same-size histories (a
+        # resubmission would answer from the verdict cache, not search).
+        colds = []
+        for seed in (7, 8, 9):
+            r = client.submit(
+                _join(_serial_lines(ops + ops // 10, seed=seed)), no_viz=True
+            )
+            if r["verdict"] != 0:
+                failures.append(_fail(f"phase2 cold seed={seed}: {r}"))
+            colds.append(r["wall_s"])
+        cold_wall = statistics.median(colds)
+        r = client.submit(_join(base), no_viz=True)
+        if r["verdict"] != 0:
+            failures.append(_fail(f"phase2 base submit: {r}"))
+        warm = client.submit(_join(extended), no_viz=True)
+        if warm["verdict"] != 0:
+            failures.append(_fail(f"phase2 warm submit: {warm}"))
+        if not warm["backend"].startswith("frontier-resume"):
+            failures.append(
+                _fail(f"phase2: warm ran {warm['backend']}, never resumed")
+            )
+        warm_wall = warm["wall_s"]
+    if warm_wall > ratio * cold_wall:
+        failures.append(
+            _fail(f"phase2: warm wall {warm_wall}s > {ratio:.0%} of cold "
+                  f"median {cold_wall}s")
+        )
+    return {
+        "ops": ops,
+        "cold_wall_s": cold_wall,
+        "warm_wall_s": warm_wall,
+        "warm_vs_cold": round(warm_wall / cold_wall, 4) if cold_wall else None,
+        "gate": ratio,
+    }
+
+
+# -- phase 3: campaign parity, warm vs prefix-less ----------------------------
+
+
+def _campaign_text(cls: str | None, workflow: str, seed: int):
+    phases = (
+        (CampaignPhase("steady", 1.0, faults=_QUIET),)
+        if cls is None
+        else (
+            CampaignPhase("warm", 0.02, faults=_QUIET),
+            CampaignPhase("violate", 1.0, faults=_QUIET, violation=cls),
+        )
+    )
+    c = Campaign(
+        name=f"gate-{cls or 'legal'}-{workflow}",
+        workflow=workflow,
+        clients=3,
+        ops=16,
+        phases=phases,
+    )
+    events, label = collect_labeled(c, seed)
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    return buf.getvalue(), label
+
+
+def _closed_cut(lines: list[str]) -> int:
+    open_ops: set = set()
+    cuts = []
+    for i, line in enumerate(lines):
+        le = ev.decode_obj(json.loads(line))
+        if le.is_start:
+            open_ops.add((le.client_id, le.op_id))
+        else:
+            open_ops.discard((le.client_id, le.op_id))
+        if not open_ops:
+            cuts.append(i + 1)
+    interior = [c for c in cuts if 0 < c < len(lines)]
+    if not interior:
+        return 0
+    return min(interior, key=lambda c: abs(c - 0.6 * len(lines)))
+
+
+def phase_parity(tmp: str, failures: list) -> dict:
+    cases = [(None, "regular"), (None, "fencing")] + [
+        (cls, wf) for cls, wf in VIOLATIONS
+    ]
+    warm_cfg = VerifydConfig(
+        socket_path=os.path.join(tmp, "p3-warm.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=30.0,
+        out_dir=os.path.join(tmp, "p3-viz"),
+        no_viz=True,
+        prefix_enabled=True,
+    )
+    cold_cfg = VerifydConfig(
+        socket_path=os.path.join(tmp, "p3-cold.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=30.0,
+        out_dir=os.path.join(tmp, "p3-viz"),
+        no_viz=True,
+        prefix_enabled=False,
+    )
+    checked = 0
+    with Verifyd(warm_cfg), Verifyd(cold_cfg):
+        warm = VerifydClient(warm_cfg.socket_path, timeout=120)
+        cold = VerifydClient(cold_cfg.socket_path, timeout=120)
+        for cls, wf in cases:
+            text, label = _campaign_text(cls, wf, seed=23)
+            expected = {"legal": 0, "illegal": 1}.get(label["expect"])
+            lines = [ln for ln in text.splitlines() if ln.strip()]
+            cut = _closed_cut(lines)
+            if cut:
+                warm.submit(_join(lines[:cut]), no_viz=True)
+            wr = warm.submit(text, no_viz=True)
+            cr = cold.submit(text, no_viz=True)
+            name = f"{cls or 'legal'}/{wf}"
+            if (wr["verdict"], wr["outcome"]) != (cr["verdict"], cr["outcome"]):
+                failures.append(
+                    _fail(f"phase3 {name}: warm {wr['verdict']}/{wr['outcome']}"
+                          f" != cold {cr['verdict']}/{cr['outcome']}")
+                )
+            if expected is not None and wr["verdict"] != expected:
+                failures.append(
+                    _fail(f"phase3 {name}: verdict {wr['verdict']} but ground "
+                          f"truth says {label['expect']}")
+                )
+            checked += 1
+    return {"cases": checked}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ratio", type=float, default=0.25,
+                    help="warm wall must be <= this fraction of cold median")
+    ap.add_argument("--ops", type=int, default=8000,
+                    help="base stream size for the wall gate")
+    args = ap.parse_args()
+    failures: list = []
+    summary: dict = {}
+    with tempfile.TemporaryDirectory(prefix="prefix-check-") as tmp:
+        summary["crash_recovery"] = phase_crash_recovery(tmp, failures)
+        summary["wall_gate"] = phase_wall_gate(
+            tmp, failures, ops=args.ops, ratio=args.ratio
+        )
+        summary["parity"] = phase_parity(tmp, failures)
+    summary["failures"] = failures
+    summary["ok"] = not failures
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,8 @@ Usage:
         [--concurrency N] [--repeat R] [--queue-depth D] [--workers W]
         [--time-budget S] [--no-viz] [--seed-collect]
         [--unique] [--unique-jobs N] [--batching] [--batch-engine E]
+        [--follow] [--follow-streams N] [--follow-windows W]
+        [--window-events E]
 
 ``--seed-collect`` first collects a few small histories into --histories
 when the directory is empty/missing, so the script is self-contained.
@@ -163,6 +165,209 @@ def _unique_histories(n: int) -> list[str]:
     return out
 
 
+def _follow_streams(n: int, windows: int, window_events: int) -> list[list[str]]:
+    """``n`` streams, each pre-cut into ``windows`` closed windows.
+
+    Single-client serial traffic (append / read alternation, reads
+    observing the fold so far), so every window boundary is op-closed
+    and every verdict is OK.  Payloads are distinct per stream, so no
+    two streams share a chain-hash lineage.
+    """
+    import io
+
+    from s2_verification_tpu.utils import events as ev
+    from s2_verification_tpu.utils.hashing import fold_record_hashes
+
+    ops_per_window = window_events // 2
+    out: list[list[str]] = []
+    for i in range(n):
+        log: list[int] = []
+        chunks: list[str] = []
+        op_id = 0
+        for _w in range(windows):
+            h: list[ev.LabeledEvent] = []
+            for _ in range(ops_per_window):
+                if op_id % 2 == 0:
+                    rec = (i * 1_000_003 + op_id * 1_009 + 1) & ((1 << 64) - 1)
+                    log.append(rec)
+                    h.append(
+                        ev.LabeledEvent(
+                            ev.AppendStart(
+                                num_records=1, record_hashes=(rec,)
+                            ),
+                            0,
+                            op_id,
+                        )
+                    )
+                    h.append(
+                        ev.LabeledEvent(
+                            ev.AppendSuccess(tail=len(log)), 0, op_id
+                        )
+                    )
+                else:
+                    h.append(ev.LabeledEvent(ev.ReadStart(), 0, op_id))
+                    h.append(
+                        ev.LabeledEvent(
+                            ev.ReadSuccess(
+                                tail=len(log),
+                                stream_hash=fold_record_hashes(0, log),
+                            ),
+                            0,
+                            op_id,
+                        )
+                    )
+                op_id += 1
+            buf = io.StringIO()
+            ev.write_history(h, buf)
+            chunks.append(buf.getvalue())
+        out.append(chunks)
+    return out
+
+
+def _follow_bench(args) -> int:
+    """Warm-vs-cold stream monitoring: the number the prefix store buys.
+
+    Warm: each stream's windows ride the ``follow`` op against a
+    prefix-enabled daemon — window N+1 resumes at the carried frontier.
+    Cold: the same streams monitored the pre-prefix way — every window
+    resubmits the whole history so far to a prefix-less daemon (each
+    cumulative text is fingerprint-distinct, so the verdict cache never
+    answers).  One verified window = one job in both phases.
+    """
+    from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+
+    streams = _follow_streams(
+        args.follow_streams, args.follow_windows, args.window_events
+    )
+    total_windows = args.follow_streams * args.follow_windows
+    print(
+        f"# follow: {args.follow_streams} streams x {args.follow_windows} "
+        f"windows x {args.window_events} events, {args.concurrency} "
+        "submitters",
+        file=sys.stderr,
+    )
+
+    def run_phase(prefix_on: bool) -> tuple[float, int, list[str]]:
+        tmp = tempfile.mkdtemp(prefix="service-bench-follow-")
+        sock = os.path.join(tmp, "verifyd.sock")
+        daemon = Verifyd(
+            VerifydConfig(
+                socket_path=sock,
+                queue_depth=args.queue_depth,
+                workers=args.workers,
+                time_budget_s=args.time_budget,
+                device="off",
+                no_viz=True,
+                out_dir=os.path.join(tmp, "viz"),
+                stats_log=None,
+                fast_admission=args.fast_admission,
+                prefix_enabled=prefix_on,
+            )
+        )
+        daemon.__enter__()
+        lock = threading.Lock()
+        cursor = [0]
+        done = [0]
+        errors: list[str] = []
+
+        def worker() -> None:
+            client = VerifydClient(sock)
+            while True:
+                with lock:
+                    if cursor[0] >= len(streams):
+                        return
+                    i = cursor[0]
+                    cursor[0] += 1
+                frontier = None
+                body = ""
+                try:
+                    for chunk in streams[i]:
+                        while True:
+                            try:
+                                if prefix_on:
+                                    reply = client.follow(
+                                        chunk,
+                                        stream=f"bench{i}",
+                                        frontier=frontier,
+                                    )
+                                    if reply.get("advanced"):
+                                        frontier = reply.get("frontier")
+                                else:
+                                    body += chunk
+                                    reply = client.submit(
+                                        body, no_viz=True
+                                    )
+                                break
+                            except VerifydBusy as e:
+                                time.sleep(min(e.retry_after_s, 5.0))
+                        if reply.get("verdict") != 0:
+                            raise VerifydError(
+                                "BadVerdict",
+                                f"stream {i}: {reply.get('verdict')}",
+                            )
+                        with lock:
+                            done[0] += 1
+                except (VerifydError, OSError) as e:
+                    with lock:
+                        errors.append(repr(e))
+                    return
+
+        t0 = time.monotonic()
+        threads = [
+            threading.Thread(target=worker, daemon=True)
+            for _ in range(min(args.concurrency, len(streams)))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        daemon.__exit__(None, None, None)
+        return wall, done[0], errors
+
+    warm_wall, warm_done, warm_errs = run_phase(prefix_on=True)
+    cold_wall, cold_done, cold_errs = run_phase(prefix_on=False)
+    for tag, errs in (("warm", warm_errs), ("cold", cold_errs)):
+        if errs:
+            print(f"# {len(errs)} {tag} errors: {errs[:3]}", file=sys.stderr)
+            return 1
+    if warm_done != total_windows or cold_done != total_windows:
+        print(
+            f"# window shortfall: warm {warm_done} cold {cold_done} "
+            f"of {total_windows}",
+            file=sys.stderr,
+        )
+        return 1
+    warm_rate = round(warm_done / warm_wall, 2) if warm_wall > 0 else 0.0
+    cold_rate = round(cold_done / cold_wall, 2) if cold_wall > 0 else 0.0
+    print(
+        f"# warm {warm_done} windows in {warm_wall:.2f}s "
+        f"({warm_rate}/s) vs cold {cold_done} in {cold_wall:.2f}s "
+        f"({cold_rate}/s)",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "service_prefix_jobs_per_sec",
+                "value": warm_rate,
+                "unit": "jobs/s",
+                "cold_jobs_per_sec": cold_rate,
+                "warm_vs_cold": (
+                    round(warm_rate / cold_rate, 3) if cold_rate else 0.0
+                ),
+                "backend": "verifyd-prefix",
+                "host_cpus": _host_cpus(),
+                "streams": args.follow_streams,
+                "windows": args.follow_windows,
+                "window_events": args.window_events,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--histories", default="./data")
@@ -214,6 +419,19 @@ def main() -> int:
                     "AdmissionController at this RSS watermark (0 "
                     "disables) — the overload gate uses this to prove "
                     "the controller costs nothing on the happy path")
+    ap.add_argument("--follow", action="store_true",
+                    help="stream-monitoring mode: verify generated streams "
+                    "window-by-window twice — warm (the follow op against "
+                    "a prefix-enabled daemon, frontier carried) and cold "
+                    "(resubmit the whole history per window, no prefix "
+                    "store) — and report service_prefix_jobs_per_sec "
+                    "with the warm_vs_cold ratio")
+    ap.add_argument("--follow-streams", type=int, default=8,
+                    help="streams the --follow mode generates (default 8)")
+    ap.add_argument("--follow-windows", type=int, default=6,
+                    help="windows per stream in --follow mode (default 6)")
+    ap.add_argument("--window-events", type=int, default=60,
+                    help="events per window in --follow mode (default 60)")
     ap.add_argument("--fleet", type=int, default=None, metavar="N",
                     help="spawn N verifyd backend *processes* behind an "
                     "in-process router (consistent-hash cache affinity, "
@@ -226,6 +444,17 @@ def main() -> int:
     if args.fleet is not None and (args.socket or args.mesh_devices):
         print("# --fleet excludes --socket / --mesh-devices", file=sys.stderr)
         return 64
+
+    if args.follow:
+        # Warm vs cold needs its own pair of in-process daemons (one
+        # with the prefix store, one without) — attach modes don't fit.
+        if args.socket or args.fleet is not None or args.mesh_devices:
+            print(
+                "# --follow excludes --socket / --fleet / --mesh-devices",
+                file=sys.stderr,
+            )
+            return 64
+        return _follow_bench(args)
 
     if args.mesh_devices is not None and not args.socket:
         # Provision the virtual topology before any jax use: inline

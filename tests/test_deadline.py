@@ -53,6 +53,13 @@ def _fingerprint(text: str) -> str:
     )
 
 
+def _ring_key(text: str) -> str:
+    """The router places by the prefix-affinity key, not the raw
+    fingerprint (see VerifydRouter._affinity_key)."""
+    hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+    return VerifydRouter._affinity_key(hist, history_fingerprint(hist))
+
+
 def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
     kw = dict(
         socket_path=str(tmp_path / "verifyd.sock"),
@@ -286,7 +293,7 @@ def test_router_decrements_deadline_across_failover(tmp_path):
         return {"verdict": 0, "outcome": "ok", "cached": False}
 
     # Whichever node the ring prefers dies first; the other answers.
-    order = router._candidate_order(_fingerprint(good_history()))[0]
+    order = router._candidate_order(_ring_key(good_history()))[0]
     order[0].client.submit = dying
     order[1].client.submit = answering
 
@@ -311,7 +318,7 @@ def test_router_refuses_third_node_when_deadline_spent(tmp_path):
         raise VerifydUnavailable("Unavailable", "connect refused")
 
     untouched = []
-    order = router._candidate_order(_fingerprint(good_history()))[0]
+    order = router._candidate_order(_ring_key(good_history()))[0]
     order[0].client.submit = dying
     order[1].client.submit = lambda *a, **kw: untouched.append(1)
 

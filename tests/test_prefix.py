@@ -1,0 +1,441 @@
+"""Incremental prefix verification: frontier cache, follow mode, parity.
+
+The soundness contract under test: a warm search resumed from a cached
+chain-hash frontier must be *verdict-equivalent* to the cold search of
+the same history — across legal shapes, every ground-truth violation
+class, and an illegal suffix appended after an OK cached prefix.  Plus
+the safety rails: snapshots only at prefix-closed boundaries, window
+verdicts never leak into fingerprint-global caches, and the on-disk
+store recovers through torn tails.
+"""
+
+import glob
+import io
+import json
+import os
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.prefix import PrefixCarry, has_open_ops
+from s2_verification_tpu.collector.campaign import (
+    Campaign,
+    CampaignPhase,
+    collect_labeled,
+)
+from s2_verification_tpu.collector.fake_s2 import FaultPlan
+from s2_verification_tpu.models.stream import StreamState
+from s2_verification_tpu.service.cache import history_fingerprint
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.prefixstore import (
+    PREFIX_SUBDIR,
+    PrefixStore,
+    make_entry,
+    plan_for_submit,
+    prefix_accumulators,
+    read_cold,
+)
+from s2_verification_tpu.service.protocol import ERR_DECODE, ERR_FRONTIER
+from s2_verification_tpu.service.router import (
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+# -- fixtures ----------------------------------------------------------------
+
+_QUIET = FaultPlan(min_latency=0.001, max_latency=0.003)
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def serial_lines(n_ops: int, seed: int = 0) -> list[str]:
+    """A serial single-client all-OK history (2 JSONL lines per op):
+    every op boundary is a closed cut."""
+    h = H()
+    hashes: list[int] = []
+    for k in range(n_ops):
+        if k % 2 == 0:
+            hashes.append(1000 + k + seed)
+            h.append_ok(1, [hashes[-1]], tail=len(hashes))
+        else:
+            h.read_ok(1, tail=len(hashes), stream_hash=fold(hashes))
+    return [ln for ln in _text(h).splitlines() if ln.strip()]
+
+
+def _join(lines: list[str]) -> str:
+    return "\n".join(lines) + "\n"
+
+
+def _prep(text: str):
+    return prepare(list(ev.iter_history(text)), elide_trivial=True)
+
+
+def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=str(tmp_path / "stats.jsonl"),
+        no_viz=True,
+        prefix_enabled=True,
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _stats_events(tmp_path) -> list[dict]:
+    with open(tmp_path / "stats.jsonl", encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _closed_cut(lines: list[str], frac: float = 0.6) -> int:
+    """Line index nearest ``frac`` through the stream where no call is
+    open — an event cut no op spans (0 when none exists)."""
+    open_ops: set = set()
+    cuts = []
+    for i, line in enumerate(lines):
+        le = ev.decode_obj(json.loads(line))
+        if le.is_start:
+            open_ops.add((le.client_id, le.op_id))
+        else:
+            open_ops.discard((le.client_id, le.op_id))
+        if not open_ops:
+            cuts.append(i + 1)
+    interior = [c for c in cuts if 0 < c < len(lines)]
+    if not interior:
+        return 0
+    target = frac * len(lines)
+    return min(interior, key=lambda c: abs(c - target))
+
+
+def _campaign(cls: str | None, workflow: str = "regular") -> Campaign:
+    phases = (
+        (CampaignPhase("steady", 1.0, faults=_QUIET),)
+        if cls is None
+        else (
+            CampaignPhase("warm", 0.02, faults=_QUIET),
+            CampaignPhase("violate", 1.0, faults=_QUIET, violation=cls),
+        )
+    )
+    name = f"t-{cls or 'legal'}-{workflow}"
+    return Campaign(
+        name=name, workflow=workflow, clients=3, ops=16, phases=phases
+    )
+
+
+# -- boundary soundness (unit) ----------------------------------------------
+
+
+def test_plan_refuses_snapshot_across_open_ops():
+    """A pending call at the end of the history: the geometric K = n
+    boundary must not be snapshotted (its outcome is undecided), and the
+    plan says why."""
+    h = H()
+    h.append_ok(1, [1], tail=1)
+    h.read_ok(1, tail=1, stream_hash=fold([1]))
+    h.append_ok(1, [2], tail=2)
+    h.read_ok(1, tail=2, stream_hash=fold([1, 2]))
+    h.call_append(2, [3])  # never finishes
+    hist = prepare(h.events)
+    assert has_open_ops(hist)
+    store = PrefixStore(capacity=8)
+    plan = plan_for_submit(store, hist, min_ops=2)
+    assert plan is not None
+    assert plan.refused == "open_ops"
+    assert len(hist.ops) not in plan.snap_keys
+
+
+def test_store_refuses_malformed_entries():
+    store = PrefixStore(capacity=8)
+    with pytest.raises(ValueError):
+        store.put("pv2:0:1", {"n": 1, "s": []})  # empty carried state set
+    with pytest.raises(ValueError):
+        PrefixCarry.from_payload({"n": 1, "s": []})
+
+
+def test_affinity_key_stable_under_extension():
+    """The router's ring key for a history and for its extension agree —
+    the whole lineage homes on the node holding the snapshots — while
+    distinct streams separate."""
+    short = _prep(_join(serial_lines(12)))
+    long = _prep(_join(serial_lines(40)))
+    other = _prep(_join(serial_lines(12, seed=7)))
+    k_short = VerifydRouter._affinity_key(short, history_fingerprint(short))
+    k_long = VerifydRouter._affinity_key(long, history_fingerprint(long))
+    k_other = VerifydRouter._affinity_key(other, history_fingerprint(other))
+    assert k_short == k_long
+    assert k_short != k_other
+
+
+# -- warm vs cold parity -----------------------------------------------------
+
+_PARITY_CASES = [
+    ("legal-serial-appends", None, None),
+    ("legal-serial-mixed", None, None),
+    ("legal-regular", None, "regular"),
+    ("legal-match-seq-num", None, "match-seq-num"),
+    ("legal-fencing", None, "fencing"),
+    ("violation-drop_acked", "drop_acked", "regular"),
+    ("violation-reorder", "reorder", "regular"),
+    ("violation-stale_read", "stale_read", "regular"),
+    ("violation-fence_resurrect", "fence_resurrect", "fencing"),
+]
+
+
+def _parity_text(name: str, cls: str | None, workflow: str | None):
+    """(history text, expected verdict) for one parity case."""
+    if workflow is None:
+        if name.endswith("appends"):
+            h = H()
+            for k in range(12):
+                h.append_ok(1, [100 + k], tail=k + 1)
+            return _text(h), 0
+        return _join(serial_lines(16)), 0
+    events, label = collect_labeled(_campaign(cls, workflow), seed=11)
+    if cls is not None:
+        assert label["fired"] and label["confirmed"], name
+        assert label["expect"] == "illegal", name
+    buf = io.StringIO()
+    ev.write_history(events, buf)
+    return buf.getvalue(), 0 if cls is None else 1
+
+
+def test_warm_vs_cold_verdict_parity(tmp_path):
+    """The acceptance gate: for five legal shapes and all four
+    ground-truth violation classes, a daemon whose store was warmed with
+    a committed prefix answers the full history with the *identical*
+    verdict a prefix-less daemon computes cold."""
+    warm_dir = tmp_path / "warm"
+    cold_dir = tmp_path / "cold"
+    warm_dir.mkdir()
+    cold_dir.mkdir()
+    warm_cfg = _daemon_cfg(warm_dir)
+    cold_cfg = _daemon_cfg(cold_dir, prefix_enabled=False)
+    resumed = 0
+    with Verifyd(warm_cfg), Verifyd(cold_cfg):
+        warm = VerifydClient(warm_cfg.socket_path, timeout=120)
+        cold = VerifydClient(cold_cfg.socket_path, timeout=120)
+        for name, cls, workflow in _PARITY_CASES:
+            text, expected = _parity_text(name, cls, workflow)
+            lines = [ln for ln in text.splitlines() if ln.strip()]
+            cut = _closed_cut(lines)
+            if cut:
+                # Commit the prefix: OK prefixes snapshot their frontier.
+                warm.submit(_join(lines[:cut]), no_viz=True)
+            warm_reply = warm.submit(text, no_viz=True)
+            cold_reply = cold.submit(text, no_viz=True)
+            assert warm_reply["verdict"] == expected, name
+            assert cold_reply["verdict"] == expected, name
+            assert warm_reply["verdict"] == cold_reply["verdict"], name
+            assert warm_reply["outcome"] == cold_reply["outcome"], name
+            assert warm_reply["ops"] == cold_reply["ops"], name
+            assert not cold_reply["backend"].startswith("frontier-resume")
+            if warm_reply["backend"].startswith("frontier-resume"):
+                resumed += 1
+    # The parity above would pass vacuously if nothing ever resumed.
+    assert resumed >= 2
+
+
+def test_illegal_suffix_after_cached_ok_prefix(tmp_path):
+    """An OK prefix is committed and cached; a later submission extends
+    it with a violating suffix.  The warm search must still answer
+    ILLEGAL — resuming from the frontier skips re-deciding the prefix,
+    never the suffix."""
+    h = H()
+    hashes = []
+    for k in range(24):
+        if k % 2 == 0:
+            hashes.append(1000 + k)
+            h.append_ok(1, [hashes[-1]], tail=len(hashes))
+        else:
+            h.read_ok(1, tail=len(hashes), stream_hash=fold(hashes))
+    prefix_text = _text(h)
+    h.read_ok(2, tail=999, stream_hash=424242)  # unjustifiable read
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        assert client.submit(prefix_text, no_viz=True)["verdict"] == 0
+        reply = client.submit(_text(h), no_viz=True)
+        assert reply["verdict"] == 1
+        assert reply["backend"].startswith("frontier-resume")
+    hits = [e for e in _stats_events(tmp_path) if e.get("ev") == "prefix_hit"]
+    assert hits and hits[-1]["resume_ops"] > 0
+
+
+# -- the store on disk -------------------------------------------------------
+
+
+def test_prefix_store_survives_torn_tail(tmp_path):
+    """A daemon killed mid-append leaves a torn record; recovery drops
+    exactly the tail and keeps every intact snapshot."""
+    d = str(tmp_path / PREFIX_SUBDIR)
+    hist = _prep(_join(serial_lines(8)))
+    keys = prefix_accumulators(hist)
+    store = PrefixStore(capacity=16, persist_dir=d)
+    for k in sorted(keys):
+        carry = PrefixCarry(
+            ops=k,
+            states=(StreamState(tail=k, stream_hash=0, fencing_token=None),),
+        )
+        store.put(keys[k], make_entry(carry, events=2 * k))
+    n = len(store)
+    assert n >= 2
+    store.close()
+    seg = sorted(glob.glob(os.path.join(d, "seg-*.log")))[-1]
+    with open(seg, "ab") as f:
+        f.write(b"\x00\x01torn")  # mid-append death
+    reopened = PrefixStore(capacity=16, persist_dir=d)
+    assert len(reopened) == n
+    assert reopened.recovery is not None
+    assert reopened.recovery.torn_tail_bytes > 0
+    reopened.close()
+    cold = read_cold(str(tmp_path))
+    assert cold is not None
+    assert cold["entries"] == n
+    assert cold["recovery"]["torn_tail_bytes"] > 0
+    assert cold["deepest_ops"] == max(keys)
+
+
+# -- follow mode -------------------------------------------------------------
+
+
+def test_follow_end_to_end_restart_and_cross_lineage(tmp_path):
+    """The full monitoring story: windows advance a frontier, the
+    lineage survives a daemon restart (same --state-dir), a full-history
+    submit resumes from snapshots a *follow* lineage wrote, and an
+    unknown token is a definite error."""
+    lines = serial_lines(60)  # 120 JSONL lines, 20 ops per 40-line window
+    state = str(tmp_path / "state")
+    cfg = _daemon_cfg(tmp_path, state_dir=state)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        r1 = client.follow(_join(lines[:40]), stream="orders")
+        assert r1["verdict"] == 0 and r1["scope"] == "window"
+        assert r1["advanced"] and r1["window"] == 0
+        assert r1["ops_total"] == 20
+        token = r1["frontier"]
+        assert token.startswith("pv")
+        r2 = client.follow(_join(lines[40:80]), stream="orders", frontier=token)
+        assert r2["verdict"] == 0 and r2["window"] == 1
+        assert r2["ops_total"] == 40
+        assert r2["backend"].startswith("frontier-resume")
+        token = r2["frontier"]
+        # Cross-lineage: the cumulative history arrives as one submit —
+        # the chain-hash keys the follow windows wrote must answer it.
+        full = client.submit(_join(lines[:80]), no_viz=True)
+        assert full["verdict"] == 0
+        assert full["backend"].startswith("frontier-resume")
+    # Reboot on the same state dir: the frontier token still resolves.
+    cfg2 = _daemon_cfg(tmp_path, state_dir=state)
+    with Verifyd(cfg2):
+        client = VerifydClient(cfg2.socket_path, timeout=120)
+        r3 = client.follow(_join(lines[80:120]), stream="orders", frontier=token)
+        assert r3["verdict"] == 0 and r3["ops_total"] == 60
+        assert r3["backend"].startswith("frontier-resume")
+        with pytest.raises(VerifydError) as exc:
+            client.follow(
+                _join(lines[:2]),
+                stream="orders",
+                frontier="pv2:00000000deadbeef:4",
+            )
+        assert exc.value.cls == ERR_FRONTIER
+    names = [e.get("ev") for e in _stats_events(tmp_path)]
+    assert "prefix_loaded" in names  # second boot replayed the log
+    assert "window_done" in names
+    assert "prefix_snapshot" in names
+
+
+def test_follow_catches_violation_in_window(tmp_path):
+    lines = serial_lines(20)
+    bad = H()
+    bad.read_ok(1, tail=1, stream_hash=99999)
+    bad_lines = [ln for ln in _text(bad).splitlines() if ln.strip()]
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        r1 = client.follow(_join(lines), stream="s")
+        assert r1["verdict"] == 0
+        r2 = client.follow(
+            _join(bad_lines), stream="s", frontier=r1["frontier"]
+        )
+        assert r2["verdict"] == 1
+        assert not r2["advanced"]  # an illegal window never commits
+        assert r2["frontier"] == r1["frontier"]  # carried, not advanced
+
+
+def test_follow_open_window_and_missing_store(tmp_path):
+    """A window with a dangling call still gets a verdict but the
+    frontier must not advance past the undecided op; a daemon without
+    the prefix store refuses the op outright."""
+    h = H()
+    h.append_ok(1, [1], tail=1)
+    h.read_ok(1, tail=1, stream_hash=fold([1]))
+    h.append_ok(1, [2], tail=2)
+    h.read_ok(1, tail=2, stream_hash=fold([1, 2]))
+    h.call_append(2, [3])  # dangling call spans the window edge
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        r = client.follow(_text(h), stream="s")
+        assert r["verdict"] == 0
+        assert not r["advanced"]
+        assert r["frontier"] is None  # lineage never started
+    nostore = tmp_path / "nostore"
+    nostore.mkdir()
+    cfg2 = _daemon_cfg(nostore, prefix_enabled=False)
+    with Verifyd(cfg2):
+        client = VerifydClient(cfg2.socket_path, timeout=120)
+        with pytest.raises(VerifydError) as exc:
+            client.follow(_join(serial_lines(8)), stream="s")
+        assert exc.value.cls == ERR_DECODE
+
+
+# -- window verdicts stay window-scoped --------------------------------------
+
+
+def test_window_verdict_never_enters_verdict_cache(tmp_path):
+    """A window OK'd under a carried frontier describes *stream-so-far*,
+    not the window text standalone — the same text submitted cold must
+    get a fresh search, not a cache answer."""
+    lines = serial_lines(40)  # 80 JSONL lines
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path, timeout=120)
+        r1 = client.follow(_join(lines[:40]), stream="s")
+        window2 = _join(lines[40:])
+        r2 = client.follow(window2, stream="s", frontier=r1["frontier"])
+        assert r2["verdict"] == 0  # OK given the carried prefix
+        standalone = client.submit(window2, no_viz=True)
+        assert not standalone.get("cached")
+        # Standalone, the suffix window is NOT linearizable (its reads
+        # observe appends committed in the prefix) — exactly why the
+        # window verdict must never answer a fingerprint-global lookup.
+        assert standalone["verdict"] == 1
+
+
+def test_router_edge_cache_refuses_window_scope(tmp_path):
+    """The router-side guard for the same rule: replies stamped
+    ``scope=window`` never populate the fingerprint-keyed edge cache."""
+    router = VerifydRouter(
+        RouterConfig(
+            listen=str(tmp_path / "r.sock"),
+            backends=(BackendSpec("a", str(tmp_path / "a.sock")),),
+        )
+    )
+    window_reply = {"verdict": 0, "scope": "window", "outcome": "OK"}
+    router._cache_store(b"k1", "fp1", "aff1", window_reply)
+    assert "fp1" not in router._verdicts
+    full_reply = {"verdict": 0, "outcome": "OK"}
+    router._cache_store(b"k2", "fp2", "aff2", full_reply)
+    assert "fp2" in router._verdicts

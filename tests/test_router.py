@@ -251,12 +251,18 @@ def _fingerprint(text: str) -> str:
     )
 
 
+def _ring_key(text: str) -> str:
+    """The router's placement key: prefix affinity, not fingerprint."""
+    hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+    return VerifydRouter._affinity_key(hist, history_fingerprint(hist))
+
+
 def _homed_at(router: VerifydRouter, node: str, base: int = 10_000) -> str:
     """A fresh linearizable history whose ring home is ``node``."""
     while True:
         base += 1000
         text = good_history(base)
-        if router.ring.preference(_fingerprint(text))[0] == node:
+        if router.ring.preference(_ring_key(text))[0] == node:
             return text
 
 
@@ -272,7 +278,7 @@ def test_router_affinity_cache_and_fleet_view(tmp_path):
         for verdict, reply in first.items():
             assert reply["verdict"] == verdict
             assert reply["node"] == router.ring.lookup(
-                _fingerprint(texts[verdict])
+                _ring_key(texts[verdict])
             )
             assert not reply.get("cached")
         # Duplicate: answered from the router's edge cache, provenance
