@@ -1436,7 +1436,7 @@ def _cmd_follow(args: argparse.Namespace) -> int:
     frontier = args.frontier
     committed: list = []  # every line already verified — the resync body
     window = 0
-    worst = 0
+    attempts = 1 + max(0, args.window_retries)
     try:
         for chunk, dangling in _iter_follow_windows(source, args.window):
             if dangling:
@@ -1449,99 +1449,135 @@ def _cmd_follow(args: argparse.Namespace) -> int:
                 )
                 break
             text = "\n".join(chunk) + "\n"
-            try:
+            # A window is only committed once its ops are actually
+            # carried into the frontier: an inconclusive verdict (e.g.
+            # deadline expiry) or a refused end-of-window snapshot
+            # leaves the frontier at the previous cut, and moving on
+            # anyway would silently drop this window's ops from the
+            # verified lineage — later windows would report OK for a
+            # stream-so-far that never included them.  Retry by
+            # resyncing (committed + chunk as a fresh lineage); if the
+            # window still won't carry, stop with the inconclusive exit
+            # code instead of following a broken lineage.
+            for attempt in range(attempts):
+                resync = attempt > 0
                 try:
-                    reply = client.follow(
-                        text,
-                        stream=args.stream,
-                        frontier=frontier,
-                        client=args.client,
-                        priority=args.priority,
-                        timeout=args.timeout,
-                        deadline_s=args.deadline,
+                    if not resync:
+                        try:
+                            reply = client.follow(
+                                text,
+                                stream=args.stream,
+                                frontier=frontier,
+                                client=args.client,
+                                priority=args.priority,
+                                timeout=args.timeout,
+                                deadline_s=args.deadline,
+                            )
+                        except VerifydError as e:
+                            if e.cls != ERR_FRONTIER:
+                                raise
+                            # The daemon no longer knows our frontier
+                            # (evicted, restarted without state, or a
+                            # router moved the stream): resync by
+                            # replaying the whole committed stream plus
+                            # this window as a fresh lineage.
+                            log.warning(
+                                "frontier unknown at window %d — "
+                                "resyncing with %d committed line(s)",
+                                window,
+                                len(committed),
+                            )
+                            resync = True
+                    if resync:
+                        reply = client.follow(
+                            "\n".join(committed + chunk) + "\n",
+                            stream=args.stream,
+                            frontier=None,
+                            client=args.client,
+                            priority=args.priority,
+                            timeout=args.timeout,
+                            deadline_s=args.deadline,
+                        )
+                except VerifydBusy as e:
+                    log.error(
+                        "verifyd is at capacity (%s); retry after ~%.1fs",
+                        e.msg,
+                        e.retry_after_s,
                     )
+                    return EXIT_BUSY
+                except VerifydUnavailable as e:
+                    log.error(
+                        "cannot reach verifyd on %s: %s", args.socket, e.msg
+                    )
+                    return EXIT_UNAVAILABLE
                 except VerifydError as e:
-                    if e.cls != ERR_FRONTIER:
-                        raise
-                    # The daemon no longer knows our frontier (evicted,
-                    # restarted without state, or a router moved the
-                    # stream): resync by replaying the whole committed
-                    # stream plus this window as a fresh lineage.
-                    log.warning(
-                        "frontier unknown at window %d — resyncing with "
-                        "%d committed line(s)",
-                        window,
-                        len(committed),
-                    )
-                    reply = client.follow(
-                        "\n".join(committed + chunk) + "\n",
-                        stream=args.stream,
-                        frontier=None,
-                        client=args.client,
-                        priority=args.priority,
-                        timeout=args.timeout,
-                        deadline_s=args.deadline,
-                    )
-            except VerifydBusy as e:
-                log.error(
-                    "verifyd is at capacity (%s); retry after ~%.1fs",
-                    e.msg,
-                    e.retry_after_s,
-                )
-                return EXIT_BUSY
-            except VerifydUnavailable as e:
-                log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
-                return EXIT_UNAVAILABLE
-            except VerifydError as e:
-                if e.cls == "DecodeError":
-                    log.error("daemon rejected the window: %s", e.msg)
-                    return USAGE_EXIT
-                log.error("follow failed: %s", e)
-                return EXIT_PROTOCOL
+                    if e.cls == "DecodeError":
+                        log.error("daemon rejected the window: %s", e.msg)
+                        return USAGE_EXIT
+                    log.error("follow failed: %s", e)
+                    return EXIT_PROTOCOL
 
-            verdict = reply.get("verdict")
-            if args.stats:
-                print(
-                    _json.dumps(
-                        {
-                            "stream": args.stream,
-                            "window": window,
-                            "ops": reply.get("ops"),
-                            "ops_total": reply.get("ops_total"),
-                            "verdict": verdict,
-                            "backend": reply.get("backend"),
-                            "frontier": reply.get("frontier"),
-                            "advanced": reply.get("advanced"),
-                            "wall_s": reply.get("wall_s"),
-                        }
-                    ),
-                    flush=True,
-                )
-            if verdict == 1:
-                log.error(
-                    "stream %s is NOT linearizable at window %d "
-                    "(%d ops total)",
-                    args.stream,
+                verdict = reply.get("verdict")
+                if args.stats:
+                    print(
+                        _json.dumps(
+                            {
+                                "stream": args.stream,
+                                "window": window,
+                                "attempt": attempt,
+                                "ops": reply.get("ops"),
+                                "ops_total": reply.get("ops_total"),
+                                "verdict": verdict,
+                                "backend": reply.get("backend"),
+                                "frontier": reply.get("frontier"),
+                                "advanced": reply.get("advanced"),
+                                "wall_s": reply.get("wall_s"),
+                            }
+                        ),
+                        flush=True,
+                    )
+                if verdict == 1:
+                    log.error(
+                        "stream %s is NOT linearizable at window %d "
+                        "(%d ops total)",
+                        args.stream,
+                        window,
+                        reply.get("ops_total") or 0,
+                    )
+                    return 1
+                # Carried: OK with the frontier advanced through this
+                # window's ops — or an all-trivial window, which has
+                # nothing a frontier could absorb (elided ops cannot
+                # change any later verdict).
+                if verdict == 0 and (
+                    reply.get("advanced") or not reply.get("ops")
+                ):
+                    break
+                log.warning(
+                    "window %d not carried (verdict %s, outcome %s, "
+                    "advanced=%s)%s",
                     window,
-                    reply.get("ops_total") or 0,
-                )
-                return 1
-            if verdict != 0:
-                log.error(
-                    "window %d inconclusive (outcome %s)",
-                    window,
+                    verdict,
                     reply.get("outcome"),
+                    bool(reply.get("advanced")),
+                    "; retrying as a resync" if attempt + 1 < attempts else "",
                 )
-                worst = max(worst, 2)
             else:
-                log.info(
-                    "window %d ok: %s ops carried to %s ops total (%s%s)",
+                log.error(
+                    "window %d never carried into the frontier after %d "
+                    "attempt(s) — stopping (%d ops verified so far)",
                     window,
-                    reply.get("ops"),
-                    reply.get("ops_total"),
-                    reply.get("backend"),
-                    "" if reply.get("advanced") else ", frontier NOT advanced",
+                    attempts,
+                    reply.get("ops_total") or len(committed),
                 )
+                return 2
+            log.info(
+                "window %d ok: %s ops carried to %s ops total (%s)",
+                window,
+                reply.get("ops"),
+                reply.get("ops_total"),
+                reply.get("backend"),
+            )
             committed.extend(chunk)
             if reply.get("advanced") and reply.get("frontier"):
                 frontier = reply["frontier"]
@@ -1564,7 +1600,7 @@ def _cmd_follow(args: argparse.Namespace) -> int:
         window,
         frontier,
     )
-    return worst
+    return 0
 
 
 def _cmd_soak(args: argparse.Namespace) -> int:
@@ -2794,6 +2830,16 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SECONDS",
         help="per-window end-to-end deadline forwarded to the daemon "
         "(default: unbounded)",
+    )
+    fo.add_argument(
+        "--window-retries",
+        type=int,
+        default=2,
+        metavar="N",
+        help="resync retries for a window whose ops were not carried "
+        "into the frontier (inconclusive verdict, refused snapshot) "
+        "before exiting 2 — moving on without a carry would silently "
+        "drop the window from the verified lineage (default 2)",
     )
     fo.add_argument(
         "-stats",

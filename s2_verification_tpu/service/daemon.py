@@ -1448,14 +1448,17 @@ class Verifyd:
                 "window_done",
                 stream=stream,
                 window=plan.window,
-                verdict="OK",
+                verdict=0,
                 advanced=advanced,
                 ops_total=plan.base_ops,
                 trace_id=trace_id,
             )
+            # Numeric verdict like every searched window (VERDICT_EXIT):
+            # clients compare ``verdict == 0``, and a string here would
+            # make them treat a vacuously-OK window as inconclusive.
             return ok(
                 {
-                    "verdict": "OK",
+                    "verdict": 0,
                     "outcome": "OK",
                     "backend": "frontier-trivial",
                     "scope": "window",

@@ -60,6 +60,7 @@ from .cache import _FP_VERSION, _op_digest
 __all__ = [
     "PrefixPlan",
     "PrefixStore",
+    "affinity_key",
     "parse_prefix_key",
     "plan_for_submit",
     "plan_for_window",
@@ -127,6 +128,34 @@ def prefix_accumulators(
         if k in want:
             out[k] = prefix_key(acc, ops_base + k)
     return out
+
+
+def affinity_key(hist: History, fingerprint: str) -> str:
+    """Ring placement key for a prepared history.
+
+    The verdict fingerprint changes whenever a single op is appended, so
+    fingerprint-keyed placement scatters a growing stream's
+    resubmissions across the fleet — every extension lands cold, away
+    from the node holding its prefix snapshots.  Keying the ring by the
+    chain-hash accumulator at the history's *first* closed boundary is
+    stable under extension (appended ops only deepen the suffix), so the
+    whole lineage — and its ``follow`` windows, which reuse the same
+    chain-hash namespace — homes on one node.  Identical texts still
+    collide (same first boundary), preserving verdict-cache affinity.
+    Histories with no closed boundary short of the end fall back to the
+    fingerprint.
+
+    This is the router's placement function (``VerifydRouter``
+    delegates here); anything predicting a job's home node — e.g. the
+    fleet gate's fresh-history picks — must use it too, never the raw
+    fingerprint.
+    """
+    bounds = closed_boundaries(hist)
+    cuts = [k for k in bounds if k < len(hist.ops)]
+    if not cuts:
+        return fingerprint
+    keys = prefix_accumulators(hist, [cuts[0]])
+    return keys.get(cuts[0], fingerprint)
 
 
 def make_entry(

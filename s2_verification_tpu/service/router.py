@@ -69,10 +69,9 @@ from ..obs.httpd import MetricsServer
 from ..obs.metrics import LATENCY_BUCKETS, MetricsRegistry
 from ..obs.probe import CircuitBreaker, HealthProber, http_health_probe
 from ..obs.trace import Tracer
-from ..checker.prefix import closed_boundaries
 from ..utils import events as ev
 from .cache import history_fingerprint
-from .prefixstore import prefix_accumulators
+from .prefixstore import affinity_key
 from .client import (
     VerifydBusy,
     VerifydClient,
@@ -770,26 +769,13 @@ class VerifydRouter:
 
     @staticmethod
     def _affinity_key(hist, fingerprint: str) -> str:
-        """Ring placement key for a prepared history.
+        """Ring placement key — :func:`.prefixstore.affinity_key`.
 
-        The verdict fingerprint changes whenever a single op is
-        appended, so fingerprint-keyed placement scatters a growing
-        stream's resubmissions across the fleet — every extension lands
-        cold, away from the node holding its prefix snapshots.  Keying
-        the ring by the chain-hash accumulator at the history's *first*
-        closed boundary is stable under extension (appended ops only
-        deepen the suffix), so the whole lineage — and its ``follow``
-        windows, which reuse the same chain-hash namespace — homes on
-        one node.  Identical texts still collide (same first boundary),
-        preserving verdict-cache affinity.  Histories with no closed
-        boundary short of the end fall back to the fingerprint.
+        The shared helper keeps the router's live placement and every
+        out-of-band prediction of it (fleet_check's fresh-history
+        picks, tests) computing the identical key.
         """
-        bounds = closed_boundaries(hist)
-        cuts = [k for k in bounds if k < len(hist.ops)]
-        if not cuts:
-            return fingerprint
-        keys = prefix_accumulators(hist, [cuts[0]])
-        return keys.get(cuts[0], fingerprint)
+        return affinity_key(hist, fingerprint)
 
     def _candidate_order(self, affinity: str) -> Tuple[List[_Backend], bool]:
         """(ordered attempt list, stolen?) for one job.
@@ -909,6 +895,18 @@ class VerifydRouter:
         else:
             fingerprint, affinity = memo
 
+        # Client-supplied scalars are validated here, like the daemon
+        # validates them, so a bad value answers ERR_DECODE instead of
+        # surfacing as an InternalError from the dispatch catch-all.
+        try:
+            priority = int(req.get("priority") or 10)
+        except (TypeError, ValueError):
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(
+                ERR_DECODE,
+                f"priority must be an int, got {req.get('priority')!r}",
+            )
         # End-to-end deadline: the client's remaining budget rides the
         # frame; the router decrements it across failovers so a job that
         # burned its budget on two dead nodes is not handed a third with
@@ -956,7 +954,7 @@ class VerifydRouter:
                 reply = b.client.submit(
                     text,
                     client=str(req.get("client") or "router"),
-                    priority=int(req.get("priority") or 10),
+                    priority=priority,
                     no_viz=req.get("no_viz"),
                     timeout=(
                         self.cfg.submit_timeout_s
@@ -1077,6 +1075,15 @@ class VerifydRouter:
             return err(
                 ERR_DECODE, "follow needs 'history' JSONL or 'records'"
             )
+        try:
+            priority = int(req.get("priority") or 10)
+        except (TypeError, ValueError):
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(
+                ERR_DECODE,
+                f"priority must be an int, got {req.get('priority')!r}",
+            )
         deadline = req.get("deadline")
         if deadline is not None:
             try:
@@ -1127,7 +1134,7 @@ class VerifydRouter:
                     stream=stream,
                     frontier=req.get("frontier"),
                     client=str(req.get("client") or "router"),
-                    priority=int(req.get("priority") or 10),
+                    priority=priority,
                     timeout=(
                         self.cfg.submit_timeout_s
                         if remaining is None

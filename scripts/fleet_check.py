@@ -56,6 +56,7 @@ from s2_verification_tpu.service.client import (  # noqa: E402
     VerifydClient,
     VerifydError,
 )
+from s2_verification_tpu.service.prefixstore import affinity_key  # noqa: E402
 from s2_verification_tpu.service.router import (  # noqa: E402
     BackendSpec,
     RouterConfig,
@@ -137,7 +138,11 @@ def _fresh_homed(router: VerifydRouter, target: str, count: int, base: int):
     Fresh (never-submitted) texts bypass the router's edge cache, so
     submitting them proves live routing decisions — rejoin re-absorption
     and drain avoidance — rather than replaying cached provenance.  The
-    home is computed with the router's own ring, so the pick is exact.
+    home is computed with the router's own ring over the same
+    prefix-stable ``affinity_key`` the router places by (the raw
+    fingerprint differs from it whenever the history has a closed
+    boundary short of the end, as these append-then-read shapes do), so
+    the pick is exact.
     """
     out = []
     while len(out) < count:
@@ -147,7 +152,8 @@ def _fresh_homed(router: VerifydRouter, target: str, count: int, base: int):
         h.read_ok(2, tail=1, stream_hash=fold([base + 1]))
         text = _render(h)
         hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
-        if router.ring.preference(history_fingerprint(hist))[0] == target:
+        key = affinity_key(hist, history_fingerprint(hist))
+        if router.ring.preference(key)[0] == target:
             out.append((f"fresh-{target}-{base}", text))
     return out, base
 

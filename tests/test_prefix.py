@@ -424,6 +424,109 @@ def test_window_verdict_never_enters_verdict_cache(tmp_path):
         assert standalone["verdict"] == 1
 
 
+def _follow_args(path, **overrides):
+    import argparse
+
+    kw = dict(
+        file=str(path),
+        socket="/tmp/nonexistent.sock",
+        secret_file=None,
+        stream="s",
+        frontier=None,
+        window=10,
+        client="cli",
+        priority=10,
+        timeout=None,
+        deadline=None,
+        window_retries=2,
+        stats=False,
+    )
+    kw.update(overrides)
+    return argparse.Namespace(**kw)
+
+
+def test_follow_cli_uncarried_window_retries_then_stops(tmp_path, monkeypatch):
+    """An inconclusive window (deadline expiry, refused snapshot) must be
+    retried as a resync and, still uncarried, stop the follow with exit 2
+    — committing it anyway would silently drop its ops from the verified
+    lineage and let later windows report OK for a stream-so-far that
+    never included them."""
+    from s2_verification_tpu import cli
+
+    lines = serial_lines(10)  # 20 JSONL lines -> two 10-event windows
+    f = tmp_path / "s.jsonl"
+    f.write_text(_join(lines))
+    calls = []
+
+    def fake_follow(
+        self, history_text=None, *, records=None, stream, frontier=None, **kw
+    ):
+        calls.append((history_text, frontier))
+        return {
+            "verdict": 2,
+            "outcome": "UNKNOWN",
+            "ops": 5,
+            "ops_total": 5,
+            "advanced": False,
+            "frontier": frontier,
+            "backend": "b",
+        }
+
+    monkeypatch.setattr(VerifydClient, "follow", fake_follow)
+    rc = cli._cmd_follow(_follow_args(f))
+    assert rc == 2
+    assert len(calls) == 3  # first try + 2 resync retries, then stop
+    assert calls[1][1] is None and calls[2][1] is None  # resyncs start cold
+    # The loop never moved past window 0: every attempt carried exactly
+    # the uncarried window's lines (committed was still empty).
+    assert all(text == _join(lines[:10]) for text, _ in calls)
+
+
+def test_follow_cli_resync_recovers_uncarried_window(tmp_path, monkeypatch):
+    """A window uncarried on the first try but carried by the resync
+    commits normally, and the next window rides the resync's frontier
+    with only its own new events."""
+    from s2_verification_tpu import cli
+
+    lines = serial_lines(10)
+    f = tmp_path / "s.jsonl"
+    f.write_text(_join(lines))
+    calls = []
+
+    def fake_follow(
+        self, history_text=None, *, records=None, stream, frontier=None, **kw
+    ):
+        calls.append((history_text, frontier))
+        n = len(calls)
+        if n == 1:
+            return {
+                "verdict": 2,
+                "outcome": "UNKNOWN",
+                "ops": 5,
+                "ops_total": 5,
+                "advanced": False,
+                "frontier": None,
+                "backend": "b",
+            }
+        ops = history_text.count("\n") // 2
+        return {
+            "verdict": 0,
+            "outcome": "OK",
+            "ops": ops,
+            "ops_total": ops,
+            "advanced": True,
+            "frontier": f"tok{n}",
+            "backend": "b",
+        }
+
+    monkeypatch.setattr(VerifydClient, "follow", fake_follow)
+    rc = cli._cmd_follow(_follow_args(f))
+    assert rc == 0
+    # window 0 try, window 0 resync (carried), window 1 on the new token
+    assert [fr for _, fr in calls] == [None, None, "tok2"]
+    assert calls[2][0] == _join(lines[10:])  # only the new events
+
+
 def test_router_edge_cache_refuses_window_scope(tmp_path):
     """The router-side guard for the same rule: replies stamped
     ``scope=window`` never populate the fingerprint-keyed edge cache."""

@@ -363,6 +363,25 @@ def test_router_decode_error_answered_at_the_edge(tmp_path):
         assert client.stats()["routed"] == 0  # no backend burned a slot
 
 
+def test_router_bad_priority_is_decode_error_not_internal(tmp_path):
+    """A non-numeric client-supplied priority answers ``DecodeError`` at
+    the edge — the daemon's contract for the same input — instead of a
+    ``ValueError`` escaping the route and surfacing as InternalError
+    from the dispatch catch-all."""
+    with VerifydRouter(_router_cfg(tmp_path, ("a",))) as router:
+        for route in (router._route_submit, router._route_follow):
+            reply = route(
+                {
+                    "history": good_history(7),
+                    "stream": "s",
+                    "priority": "urgent",
+                }
+            )
+            e = reply.get("err")
+            assert e is not None and e["class"] == "DecodeError", reply
+            assert "priority" in e["msg"]
+
+
 # -- submit --deadline --------------------------------------------------------
 
 
