@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet overload soak batch prefix perfgate lint clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet distsearch overload soak batch prefix perfgate lint clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: lint obs mesh fleet overload soak batch prefix
+chaos-full: lint obs mesh fleet distsearch overload soak batch prefix
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -110,6 +110,15 @@ prefix:
 # rejoin, clean rolling drain.
 fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_check.py
+
+# Distributed-search gate (scripts/distsearch_check.py): three subprocess
+# backends behind the router coordinate one job sized past a single
+# node's --deadline — one backend SIGKILLed mid-search, its partition
+# provably re-granted under a fresh epoch, zero stale-epoch deltas
+# accepted, verdict parity with the unbounded CPU oracle, grant ledger
+# closed on disk.
+distsearch:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/distsearch_check.py
 
 clean:
 	$(MAKE) -C native clean

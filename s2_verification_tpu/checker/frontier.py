@@ -63,7 +63,23 @@ from ..models.stream import APPEND, INIT_STATE, StreamState, step_set
 from .entries import History, Op
 from .oracle import CheckOutcome, CheckResult
 
-__all__ = ["check_frontier", "check_frontier_auto", "FrontierStats"]
+__all__ = ["check_frontier", "check_frontier_auto", "FrontierStats", "state_digest"]
+
+
+def _state_canon(s: StreamState) -> str:
+    """The canonical text of one stream state — the digest's sole input."""
+    return f"{s.tail}:{s.stream_hash}:{s.fencing_token!r}"
+
+
+def state_digest(s: StreamState) -> int:
+    """Deterministic 32-bit digest of a single stream state.
+
+    The same canon the beam tie-break digest folds per state; exposed so
+    service/distsearch.py can partition a frontier union into disjoint
+    digest ranges that both ends of the wire compute identically
+    (PYTHONHASHSEED-independent, like every digest in this module).
+    """
+    return zlib.crc32(_state_canon(s).encode())
 
 
 def _cfg_digest(cfg) -> int:
@@ -71,7 +87,7 @@ def _cfg_digest(cfg) -> int:
     counts, states = cfg
     parts = [",".join(map(str, counts))]
     for s in sorted(states):
-        parts.append(f"{s.tail}:{s.stream_hash}:{s.fencing_token!r}")
+        parts.append(_state_canon(s))
     return zlib.crc32("|".join(parts).encode())
 
 
@@ -128,6 +144,7 @@ def check_frontier(
     init_counts: tuple[int, ...] | None = None,
     init_states: Iterable[StreamState] | None = None,
     snapshot_cuts: Iterable[int] | None = None,
+    complete_cuts: bool = False,
     time_budget_s: float | None = None,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
@@ -159,6 +176,15 @@ def check_frontier(
     computed by :func:`..checker.prefix.closed_boundaries`); on an OK
     verdict the result carries ``res.snapshots`` — ``{K: sorted state
     union}`` for every cut whose union completed before any prune.
+
+    ``complete_cuts=True`` holds an accept until every requested cut's
+    union is complete: the relaxed acceptance (all remaining ops are
+    indefinite appends) normally ends the search without materializing
+    those layers, which leaves a requested cut below the frontier floor
+    incomplete — an OK *without* its end union.  Distributed partition
+    searches (service/distsearch.py) need the union itself, so they pay
+    for the held layers; the verdict is unchanged, only the return is
+    deferred until the unions are exact.
 
     ``time_budget_s`` bounds the search wall clock (checked per layer);
     expiry returns UNKNOWN, matching the other engines' budget semantics.
@@ -326,6 +352,29 @@ def check_frontier(
 
     deadline = None if time_budget_s is None else t_search + time_budget_s
 
+    def _ok_result(order, final_states) -> CheckResult:
+        res = CheckResult(
+            CheckOutcome.OK,
+            linearization=order,
+            deepest=order or [],
+            final_states=final_states,
+        )
+        if cuts:
+            snaps = {
+                K: sorted(cut[1])
+                for K, cut in cuts.items()
+                if cut[2] and cut[1]
+            }
+            if snaps:
+                res.snapshots = snaps  # type: ignore[attr-defined]
+        if collect_stats:
+            res.stats = stats  # type: ignore[attr-defined]
+        return res
+
+    #: first accepting configuration seen while ``complete_cuts`` holds
+    #: the return open (the verdict; only the unions are still cooking)
+    held: tuple | None = None
+
     layer = 0
     while True:
         layer += 1
@@ -370,6 +419,9 @@ def check_frontier(
             for K, cut in cuts.items():
                 if not cut[2] and K <= floor:
                     cut[2] = True
+            if held is not None and all(cut[2] for cut in cuts.values()):
+                _finish_layer()
+                return _ok_result(*held)
 
         for counts, states in closed:
             csum = sum(counts)
@@ -378,29 +430,22 @@ def check_frontier(
             if accepting(counts):
                 stats.max_state_set = max(stats.max_state_set, len(states))
                 layer_states = max(layer_states, len(states))
-                _finish_layer()
                 if witness:
                     pre, closed_ops = close_link[(counts, states)]
                     order = walk(pre) + closed_ops + completion(counts)
                 else:
                     order = None
-                res = CheckResult(
-                    CheckOutcome.OK,
-                    linearization=order,
-                    deepest=order or [],
-                    final_states=sorted(states),
-                )
-                if cuts:
-                    snaps = {
-                        K: sorted(cut[1])
-                        for K, cut in cuts.items()
-                        if cut[2] and cut[1]
-                    }
-                    if snaps:
-                        res.snapshots = snaps  # type: ignore[attr-defined]
-                if collect_stats:
-                    res.stats = stats  # type: ignore[attr-defined]
-                return res
+                if complete_cuts and any(
+                    not cut[2] for cut in cuts.values()
+                ):
+                    # The verdict is decided, but a requested union is
+                    # still collecting below this configuration — hold
+                    # the return and keep expanding until it is exact.
+                    if held is None:
+                        held = (order, sorted(states))
+                    continue
+                _finish_layer()
+                return _ok_result(order, sorted(states))
 
         children: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
         for counts, states in closed:
@@ -423,6 +468,12 @@ def check_frontier(
 
         if not children:
             _finish_layer()
+            if held is not None:
+                # Exhaustion: no configuration can reach any cut again,
+                # so every surviving union is final.
+                for cut in cuts.values():
+                    cut[2] = True
+                return _ok_result(*held)
             outcome = CheckOutcome.UNKNOWN if stats.pruned else CheckOutcome.ILLEGAL
             res = CheckResult(outcome, deepest=deepest_of(deep_counts))
             if collect_stats:

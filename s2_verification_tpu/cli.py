@@ -734,6 +734,10 @@ def _cmd_route_serve(args: argparse.Namespace) -> int:
             trace_capacity=args.trace_capacity,
             slo_target=args.slo_target,
             slo_latency_target_s=args.slo_latency_target,
+            state_dir=args.state_dir,
+            distsearch_segments=args.distsearch_segments,
+            distsearch_straggler_s=args.distsearch_straggler,
+            distsearch_max_regrants=args.distsearch_max_regrants,
         )
         router = VerifydRouter(cfg)
     except ValueError as e:
@@ -1303,6 +1307,7 @@ def _cmd_submit(args: argparse.Namespace) -> int:
             retries=args.retries,
             backoff_s=args.backoff,
             deadline_s=args.deadline,
+            distributed=args.distributed,
         )
     except VerifydBusy as e:
         log.error(
@@ -2425,6 +2430,41 @@ def build_parser() -> argparse.ArgumentParser:
         metavar="SECONDS",
         help="routed-submit p95 latency target (default 5.0)",
     )
+    rs.add_argument(
+        "--state-dir",
+        default=None,
+        metavar="DIR",
+        help="router durable state: the distributed-search grant ledger "
+        "lands in DIR/distsearch/ (grant-before-ship partition "
+        "ownership; replayed at boot to fence a dead coordinator's "
+        "epochs and surface orphan ranges).  Default: no ledger",
+    )
+    rs.add_argument(
+        "--distsearch-segments",
+        type=int,
+        default=3,
+        metavar="N",
+        help="distributed search: target segment count the coordinator "
+        "slices a submitted history into (default 3)",
+    )
+    rs.add_argument(
+        "--distsearch-straggler",
+        type=float,
+        default=10.0,
+        metavar="SECONDS",
+        help="distributed search: partition runtime after which an idle "
+        "healthy node steals the range under a new epoch (0 disables; "
+        "default 10)",
+    )
+    rs.add_argument(
+        "--distsearch-max-regrants",
+        type=int,
+        default=3,
+        metavar="N",
+        help="distributed search: re-grants per partition (failover or "
+        "inconclusive owner) before the merged verdict degrades to "
+        "UNKNOWN (default 3)",
+    )
     rs.set_defaults(fn=_cmd_route_serve)
 
     def _route_op_parser(name: str, help_text: str):
@@ -2751,6 +2791,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     u.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
+    )
+    u.add_argument(
+        "-distributed",
+        "--distributed",
+        action="store_true",
+        help="ask a verifyd-router to run the search fleet-distributed: "
+        "the frontier is partitioned by state-hash range across healthy "
+        "backends and the merged verdict carries partition/epoch "
+        "telemetry.  Plain daemons (and routers without >= 2 healthy "
+        "backends) serve the submit single-node — the flag degrades, "
+        "never fails",
     )
     u.add_argument(
         "-stats",

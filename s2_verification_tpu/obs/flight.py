@@ -248,6 +248,14 @@ def postmortem(
     from ..service.prefixstore import read_cold as read_prefix_cold
 
     prefix_store = read_prefix_cold(state_dir)
+
+    # Distributed-search grant ledger (a router's state dir): partition
+    # ownership open at death, per-search epochs, and the last delta per
+    # range — the post-mortem of a coordinator killed mid-search.
+    from ..service.journal import read_grants_cold
+
+    distsearch = read_grants_cold(state_dir)
+
     prefix_activity: Dict[str, int] = {}
     for ev in events:
         name = ev.get("ev") or ev.get("event")
@@ -282,6 +290,7 @@ def postmortem(
         "slo_at_death": slo_at_death,
         "prefix_store": prefix_store,
         "prefix_activity": prefix_activity,
+        "distsearch": distsearch,
         # Resource timeline before death: keep the tail — the interesting
         # part of an OOM story is the last few minutes, not the first.
         "resources": resources[-tail:],
@@ -531,6 +540,67 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                     activity.get("window_done", 0),
                 )
             )
+
+    ds = pm.get("distsearch")
+    if ds is not None:
+        rec = ds.get("recovery") or {}
+        add("")
+        add(
+            "-- distributed search: %d search(es), %d grant(s) open at "
+            "death --"
+            % (len(ds.get("searches", {})), ds.get("open_total", 0))
+        )
+        add(
+            "  ledger: %s record(s) in %s segment(s), torn tail %sB, "
+            "%s bad segment(s)"
+            % (
+                rec.get("records", "?"),
+                rec.get("segments", "?"),
+                rec.get("torn_tail_bytes", "?"),
+                rec.get("bad_segments", "?"),
+            )
+        )
+        for search, info in sorted(ds.get("searches", {}).items())[:10]:
+            verdict = info.get("verdict")
+            add(
+                "  search %s  %s  segs=%s parts=%s max_epoch=%s fences=%s"
+                % (
+                    search[:16],
+                    (
+                        "UNDECIDED AT DEATH"
+                        if verdict is None
+                        else "verdict=%s (%s)" % (verdict, info.get("outcome"))
+                    ),
+                    info.get("segs", "?"),
+                    info.get("parts", "?"),
+                    info.get("max_epoch", 0),
+                    info.get("fences", 0),
+                )
+            )
+            for g in (info.get("open_grants") or [])[:8]:
+                add(
+                    "    OPEN range %s  node=%s epoch=%s (%s) seg=%s"
+                    % (
+                        g.get("part"),
+                        g.get("node"),
+                        g.get("epoch"),
+                        g.get("reason"),
+                        str(g.get("seg", ""))[:20],
+                    )
+                )
+            for part, d in sorted((info.get("last_delta") or {}).items())[:8]:
+                add(
+                    "    last delta range %s  node=%s epoch=%s verdict=%s "
+                    "states=%s bytes=%s"
+                    % (
+                        part,
+                        d.get("node"),
+                        d.get("epoch"),
+                        d.get("verdict"),
+                        d.get("states"),
+                        d.get("bytes"),
+                    )
+                )
 
     if pm.get("resources"):
         add("")

@@ -1,5 +1,5 @@
 """Multi-chip / multi-host parallelism helpers."""
 
-from .distributed import frontier_mesh, init_distributed
+from .distributed import frontier_mesh, init_distributed, multiprocess_supported
 
-__all__ = ["init_distributed", "frontier_mesh"]
+__all__ = ["init_distributed", "frontier_mesh", "multiprocess_supported"]

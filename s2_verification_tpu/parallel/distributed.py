@@ -28,7 +28,7 @@ pattern.
 
 from __future__ import annotations
 
-__all__ = ["init_distributed", "frontier_mesh"]
+__all__ = ["init_distributed", "frontier_mesh", "multiprocess_supported"]
 
 
 def init_distributed(
@@ -68,6 +68,44 @@ def init_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def multiprocess_supported() -> "tuple[bool, str]":
+    """Probe whether the active backend implements multi-process
+    collectives: ``(True, "")`` when it does, ``(False, reason)`` when
+    the runtime is joined but the backend cannot execute cross-process
+    ops (notably CPU: XLA answers ``Multiprocess computations aren't
+    implemented on the CPU backend``).
+
+    Call after :func:`init_distributed`.  The probe broadcasts one
+    scalar — the cheapest op that exercises the same
+    ``broadcast_one_to_all`` path every cross-process ``device_put``
+    takes, and one that fails *locally at compile time* on an
+    unsupporting backend, so no process blocks waiting for a peer that
+    already bailed.  Unrecognized failures re-raise: a genuinely broken
+    cluster must not masquerade as an unsupported backend.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return True, ""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    try:
+        multihost_utils.broadcast_one_to_all(jnp.int32(1))
+    except Exception as e:  # noqa: BLE001 — classify, re-raise the rest
+        msg = str(e)
+        probe = msg.lower()
+        if (
+            "aren't implemented" in probe
+            or "not implemented" in probe
+            or "unimplemented" in probe
+        ):
+            reason = msg.strip().splitlines()[-1].strip()
+            return False, reason
+        raise
+    return True, ""
 
 
 def frontier_mesh(axis: str = "fr", devices=None):

@@ -314,6 +314,7 @@ class VerifydClient:
         timeout: float | None = None,
         trace_id: str | None = None,
         deadline_s: float | None = None,
+        distributed: bool = False,
     ) -> dict:
         """Submit one history.  Mints a distributed ``trace_id`` (unless
         the caller supplies one, e.g. across a retry loop) and sends it in
@@ -330,7 +331,12 @@ class VerifydClient:
         ``records`` submits the history as an already-decoded list of
         event objects instead of a JSONL string — one less
         serialize/parse round-trip on the hot path.  Exactly one of
-        ``history_text`` / ``records`` must be given."""
+        ``history_text`` / ``records`` must be given.
+
+        ``distributed`` asks a router to run the search fleet-wide
+        (service/distsearch.py): the frontier is partitioned by
+        state-hash range across healthy backends.  Daemons and routers
+        without the capability ignore the flag and route normally."""
         if (history_text is None) == (records is None):
             raise ValueError("submit takes exactly one of history_text / records")
         tid = trace_id or new_trace_id()
@@ -348,10 +354,103 @@ class VerifydClient:
             req["no_viz"] = no_viz
         if deadline_s is not None:
             req["deadline"] = float(deadline_s)
+        if distributed:
+            req["distributed"] = True
         reply = self._call(req, timeout=timeout)
         if isinstance(reply, dict):
             reply.setdefault("trace_id", tid)
         return reply
+
+    def grant(
+        self,
+        *,
+        search: str,
+        seg: str,
+        part: str,
+        epoch: int,
+        timeout: float | None = 10.0,
+    ) -> dict:
+        """Distributed search: claim partition ownership on a backend.
+
+        The coordinator journals the grant *before* this call, so a
+        crash between journal and wire leaves an orphan grant the next
+        epoch re-grants.  A backend holding a newer epoch for the same
+        partition answers the definite ``EpochFenced``."""
+        req = {
+            "op": "grant",
+            "search": search,
+            "seg": seg,
+            "part": part,
+            "epoch": int(epoch),
+        }
+        return self._call(req, timeout=timeout)
+
+    def delta(
+        self,
+        history_text: str,
+        *,
+        search: str,
+        seg: str,
+        part: str,
+        epoch: int,
+        carry: dict,
+        union: bool = True,
+        client: str = "distsearch",
+        deadline_s: float | None = None,
+        timeout: float | None = None,
+        trace_id: str | None = None,
+    ) -> dict:
+        """Distributed search: ship one segment + partition carry and
+        block for the partition's end-of-segment union.  ``carry`` is the
+        prefix-carry payload (checker/prefix.py) holding this
+        partition's share of the boundary state union.  The backend
+        fences the epoch both on entry and again when the verdict is
+        ready — a revocation that lands mid-search turns the eventual
+        reply into ``EpochFenced``.  ``union=False`` (the final segment)
+        skips collecting the end union — the verdict alone suffices, and
+        the backend may accept early instead of materializing every
+        indefinite-append layer."""
+        tid = trace_id or new_trace_id()
+        req: dict = {
+            "op": "delta",
+            "history": history_text,
+            "client": client,
+            "search": search,
+            "seg": seg,
+            "part": part,
+            "epoch": int(epoch),
+            "carry": carry,
+            TRACE_FIELD: trace_frame(tid),
+        }
+        if not union:
+            req["union"] = False
+        if deadline_s is not None:
+            req["deadline"] = float(deadline_s)
+        reply = self._call(req, timeout=timeout)
+        if isinstance(reply, dict):
+            reply.setdefault("trace_id", tid)
+        return reply
+
+    def partition_done(
+        self,
+        *,
+        search: str,
+        part: str,
+        epoch: int,
+        reason: str = "done",
+        timeout: float | None = 10.0,
+    ) -> dict:
+        """Distributed search: close (or revoke) a partition grant.  An
+        epoch at or above the backend's recorded grant closes it and
+        cancels any in-flight partition job; an older epoch is fenced."""
+        req = {
+            "op": "partition_done",
+            "search": search,
+            "part": part,
+            "epoch": int(epoch),
+            "reason": reason,
+        }
+        return self._call(req, timeout=timeout)
 
     def follow(
         self,

@@ -73,6 +73,32 @@ Ops:
                 journal cleanly, then stop — the router's rolling
                 restart sends this.
 
+Distributed-search ops (coordinator → backend; ``service/distsearch.py``
+orchestrates, ``service/router.py`` hosts the coordinator):
+
+``grant``     → ownership handshake for one frontier partition:
+                ``search`` (the job fingerprint), ``seg`` (segment cut
+                key), ``part`` (digest range id), ``epoch`` (monotone
+                fencing counter).  The backend records the grant and
+                refuses any frame for the pair carrying an *older* epoch
+                with the **definite** ``EpochFenced`` — the coordinator
+                journals the grant before sending, so an unclean death
+                leaves a re-grantable record, never a lost range.
+``delta``     → run one partition of a segment: the segment history
+                (``history``/``records``) plus ``carry`` — the partition's
+                share of the frontier union in the prefix-carry payload
+                shape (checker/prefix.py).  Deferred reply like
+                ``submit``; the backend checks the grant epoch both at
+                admission and again when the verdict is ready (a
+                revocation landing mid-search turns the reply into
+                ``EpochFenced`` instead of a zombie delta).  Reply carries
+                ``verdict``/``outcome`` and, on OK, ``states`` — the
+                end-of-segment union the coordinator merges.
+``partition_done`` → close or revoke a grant (``reason`` = ``done`` /
+                ``revoked``): the backend drops the grant entry when the
+                epoch is current-or-newer and cancels any in-flight
+                partition search for the pair.
+
 Router ops (``service/router.py`` speaks this same protocol and adds):
 
 ``fleet``     → ``{"ok": {"ring": {...}, "backends": [...]}}`` —
@@ -136,6 +162,7 @@ __all__ = [
     "ERR_SHUTTING_DOWN",
     "ERR_NO_BACKEND",
     "ERR_DEADLINE",
+    "ERR_EPOCH",
     "ERR_FRONTIER",
     "ERR_QUARANTINED",
     "ERR_CANCELLED",
@@ -188,6 +215,14 @@ ERR_FRONTIER = "UnknownFrontier"
 #: the submit could not be placed.  Transient — clients retry like
 #: :data:`ERR_SHUTTING_DOWN`.
 ERR_NO_BACKEND = "NoBackend"
+#: Definite: a distributed-search frame (``grant``/``delta``) carried an
+#: epoch older than the one this node holds for the partition, or named a
+#: grant that was revoked underneath the sender.  The fencing answer of
+#: the distsearch protocol: a zombie owner that missed its own revocation
+#: gets this instead of an accepted delta, and the coordinator applies
+#: the same check once more at merge time — retrying the stale epoch is
+#: pointless, the partition already belongs to a newer grant.
+ERR_EPOCH = "EpochFenced"
 
 #: check-CLI exit code per outcome value (cli.py docstring contract).
 VERDICT_EXIT = {"ok": 0, "illegal": 1, "unknown": 2}
@@ -221,6 +256,10 @@ FRAME_FIELDS = {
         "no_viz": "optional",
         "deadline": "optional",
         "trace": "optional",
+        # Route the submit through the fleet-distributed frontier search
+        # (router only; service/distsearch.py).  Optional and ignored by
+        # plain daemons, so old peers keep interoperating.
+        "distributed": "optional",
     },
     "follow": {
         # Same one-of history/records contract as submit, plus the
@@ -247,6 +286,38 @@ FRAME_FIELDS = {
     "quarantine": {"action": "optional", "fingerprint": "optional"},
     "drain": {"node": "required", "timeout": "optional"},
     "undrain": {"node": "required"},
+    # Distributed-search ops (coordinator → backend; service/distsearch.py).
+    # All fields optional at the frame layer for old-peer interop; the
+    # daemon enforces the semantic requirements (search/part/epoch) itself.
+    "grant": {
+        "search": "optional",
+        "seg": "optional",
+        "part": "optional",
+        "epoch": "optional",
+        "trace": "optional",
+    },
+    "delta": {
+        # Same one-of history/records payload contract as submit, plus the
+        # partition identity and the carried frontier union.
+        "history": "optional",
+        "records": "optional",
+        "client": "optional",
+        "deadline": "optional",
+        "trace": "optional",
+        "search": "optional",
+        "seg": "optional",
+        "part": "optional",
+        "epoch": "optional",
+        "carry": "optional",
+        "union": "optional",
+    },
+    "partition_done": {
+        "search": "optional",
+        "part": "optional",
+        "epoch": "optional",
+        "reason": "optional",
+        "trace": "optional",
+    },
 }
 
 #: The only fields excluded from the HMAC canonicalization — the MAC

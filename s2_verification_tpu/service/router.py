@@ -269,6 +269,18 @@ class RouterConfig:
     slo_latency_target_s: float = 5.0
     frame_max_bytes: int = MAX_FRAME_BYTES
     conn_deadline_s: float = 30.0
+    #: durable state (currently: the distributed-search grant ledger at
+    #: ``<state_dir>/distsearch/``); None = coordinate without a ledger
+    state_dir: Optional[str] = None
+    #: distributed search (``submit --distributed``): target segment
+    #: count for the coordinator's history slicing
+    distsearch_segments: int = 3
+    #: seconds before a straggling partition is stolen by an idle node
+    distsearch_straggler_s: float = 10.0
+    #: per-delta wire timeout (None = bounded by the job deadline only)
+    distsearch_attempt_timeout_s: Optional[float] = None
+    #: re-grants per partition before the search degrades to UNKNOWN
+    distsearch_max_regrants: int = 3
     extra: dict = field(default_factory=dict)
 
 
@@ -320,6 +332,41 @@ class VerifydRouter:
         self._cache_lock = threading.Lock()
         self._text_fp: "OrderedDict[bytes, tuple]" = OrderedDict()
         self._verdicts: "OrderedDict[str, dict]" = OrderedDict()
+
+        # Distributed search (service/distsearch.py): the grant ledger
+        # journals partition ownership grant-before-ship; recovery lifts
+        # every re-run of an undecided search above the epochs a dead
+        # coordinator handed out, so zombie grants can never fence a
+        # fresh run's deltas.
+        self._grant_ledger = None
+        self._ds_floors: Dict[str, int] = {}
+        ds_orphans = 0
+        if config.state_dir:
+            from .journal import GRANTS_SUBDIR, GrantLedger
+
+            self._grant_ledger = GrantLedger(
+                os.path.join(config.state_dir, GRANTS_SUBDIR)
+            )
+            orphans, self._ds_floors = self._grant_ledger.recover()
+            ds_orphans = len(orphans)
+            if orphans:
+                log.warning(
+                    "grant ledger: %d orphan partition grant(s) from a "
+                    "previous coordinator; epochs fenced above %s",
+                    ds_orphans,
+                    {k[:12]: v for k, v in self._ds_floors.items()},
+                )
+        self._ds_active: Dict[str, Any] = {}
+        self._ds_counters = {
+            "searches": 0,
+            "granted": 0,
+            "stolen": 0,
+            "regranted": 0,
+            "fenced": 0,
+            "delta_bytes": 0,
+            "fallbacks": 0,
+            "orphans_recovered": ds_orphans,
+        }
 
         r = self.registry
         lbl = ("backend",)
@@ -383,6 +430,34 @@ class VerifydRouter:
         self._m_cache_hits = r.counter(
             "verifyd_router_cache_hits_total",
             "Duplicate submits answered from the router's edge cache",
+        )
+        self._m_ds_searches = r.counter(
+            "verifyd_distsearch_searches_total",
+            "Distributed searches coordinated by this router",
+        )
+        self._m_ds_granted = r.counter(
+            "verifyd_distsearch_partitions_granted_total",
+            "Partition grants issued (initial grants, re-grants and steals)",
+        )
+        self._m_ds_stolen = r.counter(
+            "verifyd_distsearch_partitions_stolen_total",
+            "Partitions stolen from stragglers by idle healthy nodes",
+        )
+        self._m_ds_regranted = r.counter(
+            "verifyd_distsearch_partitions_regranted_total",
+            "Partitions re-granted after a failed or inconclusive owner",
+        )
+        self._m_ds_delta_bytes = r.counter(
+            "verifyd_distsearch_delta_bytes_total",
+            "Serialized frontier-delta state-union bytes merged",
+        )
+        self._m_ds_fences = r.counter(
+            "verifyd_distsearch_epoch_fences_total",
+            "Stale-epoch deltas rejected at the coordinator's merge fence",
+        )
+        self._m_ds_fallbacks = r.counter(
+            "verifyd_distsearch_fallbacks_total",
+            "Distributed submits degraded to the single-node route",
         )
         for name in names:
             self._m_up.set(0, backend=name)
@@ -500,6 +575,9 @@ class VerifydRouter:
             self._thread.join(timeout=10)
         self.prober.close()
         self._pool.shutdown(wait=False)
+        if self._grant_ledger is not None:
+            with contextlib.suppress(Exception):
+                self._grant_ledger.close()
         if self._metrics_server is not None:
             self._metrics_server.close()
         if not self._is_tcp_listener:
@@ -677,10 +755,17 @@ class VerifydRouter:
             if op == "submit":
                 # Edge-cache fast path: an exact duplicate of a decided
                 # history is answered on the loop thread — no executor
-                # hop, no prepare, no backend round-trip.
+                # hop, no prepare, no backend round-trip.  Distributed
+                # submits share it: the merged verdict is a full-history
+                # verdict, so a duplicate needs no second fleet search.
                 fast = self._cached_submit(req)
                 if fast is not None:
                     return fast
+                if req.get("distributed"):
+                    return await self._loop.run_in_executor(
+                        self._pool,
+                        functools.partial(self._route_distributed, req),
+                    )
                 return await self._loop.run_in_executor(
                     self._pool, functools.partial(self._route_submit, req)
                 )
@@ -746,7 +831,7 @@ class VerifydRouter:
         cap = self.cfg.cache_capacity
         if cap <= 0:
             return
-        if reply.get("scope") == "window":
+        if reply.get("scope") in ("window", "partition"):
             return
         if reply.get("verdict") not in (0, 1):
             return
@@ -1045,6 +1130,155 @@ class VerifydRouter:
             attempts=attempts,
         )
 
+    # -- distributed search (service/distsearch.py coordinator) --------------
+
+    def _ds_count(self, kind: str, n: int = 1) -> None:
+        """Coordinator → router metrics bridge (thread-safe)."""
+        with self._lock:
+            if kind in self._ds_counters:
+                self._ds_counters[kind] += n
+        metric = {
+            "granted": self._m_ds_granted,
+            "stolen": self._m_ds_stolen,
+            "regranted": self._m_ds_regranted,
+            "fenced": self._m_ds_fences,
+            "delta_bytes": self._m_ds_delta_bytes,
+        }.get(kind)
+        if metric is not None:
+            metric.inc(n)
+
+    def _route_distributed(self, req: dict) -> dict:
+        """Coordinate one ``submit --distributed`` across the fleet.
+
+        The router slices the history into segments and partitions each
+        boundary state union by digest range over the healthy backends
+        (:mod:`.distsearch`).  Every degradation — too few nodes, no
+        usable cut, an unmergeable partition result — falls back to the
+        plain single-node route: distributed mode can be slower than a
+        lone backend, never wronger.  The merged verdict is a
+        full-history verdict, so it enters the edge cache like any
+        routed submit.
+        """
+        from .distsearch import Coordinator, DistSearchConfig, DistSearchError
+        from .overload import CancelToken
+
+        text = req.get("history")
+        if not isinstance(text, str) or not text.strip():
+            # records-based distributed submits are not coordinated at
+            # the edge; the plain route validates and serves them.
+            return self._route_submit(req)
+        self._m_jobs.inc()
+        trace_id, _sent_wall = parse_trace_frame(req.get(TRACE_FIELD))
+        if trace_id is None:
+            trace_id = new_trace_id()
+        deadline = req.get("deadline")
+        if deadline is not None:
+            try:
+                deadline = float(deadline)
+            except (TypeError, ValueError):
+                self._bump("decode_errors")
+                self._m_decode.inc()
+                return err(
+                    ERR_DECODE, f"deadline must be a number, got {deadline!r}"
+                )
+        try:
+            events = list(ev.iter_history(text))
+            hist = prepare(events, elide_trivial=True)
+        except (ev.DecodeError, ValueError) as e:
+            self._bump("decode_errors")
+            self._m_decode.inc()
+            return err(ERR_DECODE, str(e))
+        fingerprint = history_fingerprint(hist)
+        affinity = self._affinity_key(hist, fingerprint)
+        text_key = self._text_key(text)
+        # Canonical one-line-per-event serialization: iter_history
+        # accepts arbitrarily packed JSONL, so slicing the *client's*
+        # lines by event index would mis-cut — re-serialize first.
+        lines = [ev.encode_event(le) for le in events]
+
+        def _nodes():
+            return [
+                (name, b.client)
+                for name, b in sorted(self._backends.items())
+                if b.routable()
+            ]
+
+        cancel = CancelToken(
+            time.monotonic() + deadline if deadline is not None else None
+        )
+        coord = Coordinator(
+            search=fingerprint,
+            nodes=_nodes,
+            ledger=self._grant_ledger,
+            config=DistSearchConfig(
+                segments=self.cfg.distsearch_segments,
+                straggler_s=self.cfg.distsearch_straggler_s,
+                attempt_timeout_s=self.cfg.distsearch_attempt_timeout_s,
+                max_regrants=self.cfg.distsearch_max_regrants,
+            ),
+            cancel=cancel,
+            epoch_floor=self._ds_floors.get(fingerprint, 0),
+            counter=self._ds_count,
+            trace_id=trace_id,
+        )
+        with self._lock:
+            self._ds_counters["searches"] += 1
+            self._ds_active[fingerprint] = coord
+        self._m_ds_searches.inc()
+        t0 = self.tracer.now()
+        seq = next(self._seq)
+        try:
+            summary = coord.run(lines, events, hist)
+        except DistSearchError as e:
+            log.warning(
+                "distributed search %s degraded to single-node: %s",
+                fingerprint[:12],
+                e,
+            )
+            with self._lock:
+                self._ds_counters["fallbacks"] += 1
+            self._m_ds_fallbacks.inc()
+            return self._route_submit(req)
+        finally:
+            with self._lock:
+                self._ds_floors[fingerprint] = max(
+                    self._ds_floors.get(fingerprint, 0), coord._epoch
+                )
+                self._ds_active.pop(fingerprint, None)
+        if summary.get("reason") == "deadline":
+            self.health.observe_event({"ev": "job_error"})
+            return err(
+                ERR_DEADLINE,
+                "deadline spent mid-distributed-search",
+                reason="deadline",
+            )
+        reply = dict(summary)
+        reply["node"] = "distributed"
+        reply.setdefault("trace_id", trace_id)
+        wall = reply.get("wall_s") or 0.0
+        self.health.observe_event(
+            {"ev": "done", "wall_s": wall, "queue_wait_s": 0.0}
+        )
+        if self.tracer.enabled:
+            self.tracer.name_track(seq, f"distsearch {seq}")
+            self.tracer.add_span(
+                "distsearch",
+                t0,
+                self.tracer.now(),
+                tid=seq,
+                cat="router",
+                args={
+                    "trace_id": trace_id,
+                    "fingerprint": fingerprint,
+                    "verdict": reply.get("verdict"),
+                    "partitions": reply.get("partitions"),
+                    "regrants": reply.get("regrants"),
+                    "fences": reply.get("fences"),
+                },
+            )
+        self._cache_store(text_key, fingerprint, affinity, reply)
+        return ok(reply)
+
     def _route_follow(self, req: dict) -> dict:
         """Route one ``follow`` window by stream affinity.
 
@@ -1282,6 +1516,13 @@ class VerifydRouter:
     def snapshot(self) -> dict:
         with self._lock:
             snap: Dict[str, Any] = dict(self._counters)
+            ds: Dict[str, Any] = dict(self._ds_counters)
+            ds["active"] = {
+                search[:16]: dict(coord.active)
+                for search, coord in self._ds_active.items()
+            }
+        ds["ledger"] = self._grant_ledger is not None
+        snap["distsearch"] = ds
         snap["uptime_s"] = round(time.time() - self._t0, 3)
         snap["backends"] = {
             name: {

@@ -248,8 +248,14 @@ class Scheduler:
         """Follow-window jobs: verdicts are window-scoped (computed from a
         carried frontier, not op 0), so they must never enter the verdict
         cache or the journal — a replay or a fingerprint twin would serve
-        a rolling verdict as if it were a cold full-history one."""
-        return job.prefix is not None and job.prefix.kind == "window"
+        a rolling verdict as if it were a cold full-history one.
+        Distributed-search partition jobs (``kind == "partition"``) carry
+        the same hazard: their verdict covers one partition of one
+        segment, never the whole history."""
+        return job.prefix is not None and job.prefix.kind in (
+            "window",
+            "partition",
+        )
 
     def _mark_done(self, job: Job, *, verdict: int | None, outcome: str) -> None:
         if self.journal is None or self._is_window(job):
@@ -450,8 +456,18 @@ class Scheduler:
         if self._is_window(job):
             # A follow window's verdict only covers the suffix relative to
             # its carry; its "fingerprint" is the cut key (pv2:...), and
-            # the payload is marked so edges scope it too.
-            payload["scope"] = "window"
+            # the payload is marked so edges scope it too.  Partition jobs
+            # are scoped likewise and additionally ship their
+            # end-of-segment union back to the coordinator.
+            kind = job.prefix.kind
+            payload["scope"] = "partition" if kind == "partition" else "window"
+            if kind == "partition" and res.outcome == CheckOutcome.OK:
+                from .distsearch import pack_states
+
+                snaps = getattr(res, "snapshots", None) or {}
+                states = snaps.get(len(job.hist.ops))
+                if states is not None:
+                    payload["states"] = pack_states(states)
         # Inconclusive verdicts are not cached: a resubmission may get a
         # healthier device or a bigger budget and deserves a fresh run.
         # Window verdicts are never cached at all (see _is_window).
@@ -555,7 +571,7 @@ class Scheduler:
         (carry present) from ``search.cold`` (probe missed; this search
         merely seeds the store).
         """
-        from ..checker.frontier import check_frontier_auto
+        from ..checker.frontier import check_frontier, check_frontier_auto
 
         plan = job.prefix
         init_counts = init_states = None
@@ -565,16 +581,38 @@ class Scheduler:
                 init_counts = plan.resume_counts
         mode = "resume" if plan.carry is not None else "cold"
         t0 = time.monotonic()
-        res = check_frontier_auto(
-            job.hist,
-            collect_stats=True,
-            witness=False,
-            profile=self.profile,
-            init_counts=init_counts,
-            init_states=init_states,
-            snapshot_cuts=sorted(plan.snap_keys) or None,
-            time_budget_s=budget,
-        )
+        if plan.kind == "partition":
+            # Distributed-search partition: the coordinator merges
+            # end-of-segment unions, so the search must be EXHAUSTIVE —
+            # the beam escalation inside check_frontier_auto prunes
+            # configurations, and a pruned union merged upstream would be
+            # silently unsound.  Auto-close stays on (it is
+            # reachability-preserving per partition).
+            mode = "partition"
+            res = check_frontier(
+                job.hist,
+                collect_stats=True,
+                witness=False,
+                profile=self.profile,
+                init_states=init_states,
+                snapshot_cuts=sorted(plan.snap_keys) or None,
+                # The coordinator merges the end union, so an early
+                # accept (all-indefinite tail) must not return before
+                # the cut's union is exact.
+                complete_cuts=bool(plan.snap_keys),
+                time_budget_s=budget,
+            )
+        else:
+            res = check_frontier_auto(
+                job.hist,
+                collect_stats=True,
+                witness=False,
+                profile=self.profile,
+                init_counts=init_counts,
+                init_states=init_states,
+                snapshot_cuts=sorted(plan.snap_keys) or None,
+                time_budget_s=budget,
+            )
         self.tracer.add_span(
             f"search.{mode}",
             t0,

@@ -155,6 +155,10 @@ class ServiceStats:
             "prefix_refused": 0,
             "prefix_loaded": 0,
             "windows_done": 0,
+            "partitions_granted": 0,
+            "partition_deltas": 0,
+            "partitions_done": 0,
+            "epoch_fences": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -376,6 +380,30 @@ class ServiceStats:
         self._m_windows = r.counter(
             "verifyd_follow_windows_total",
             "Follow windows answered with a window-scoped verdict",
+        )
+        # Distributed search, backend side (service/daemon.py _ds_* ops;
+        # the coordinator's own families live on the router registry).
+        self._m_ds_granted = r.counter(
+            "verifyd_distsearch_partitions_granted_total",
+            "Partition ownership grants accepted by this backend",
+        )
+        self._m_ds_deltas = r.counter(
+            "verifyd_distsearch_deltas_total",
+            "Frontier deltas answered, by partition verdict",
+            labelnames=("verdict",),
+        )
+        self._m_ds_delta_bytes = r.counter(
+            "verifyd_distsearch_delta_bytes_total",
+            "Serialized end-of-segment state-union bytes shipped back",
+        )
+        self._m_ds_done = r.counter(
+            "verifyd_distsearch_partitions_done_total",
+            "Partition grants closed (done, revoked or failed)",
+        )
+        self._m_ds_fences = r.counter(
+            "verifyd_distsearch_epoch_fences_total",
+            "Stale-epoch frames refused, by op",
+            labelnames=("op",),
         )
         # Resource telemetry (obs/introspect.ResourceSampler sets these).
         self._m_res_rss = r.gauge(
@@ -633,6 +661,29 @@ class ServiceStats:
         elif event == "window_done":
             self._counters["windows_done"] += 1
             self._m_windows.inc()
+        elif event == "partition_granted":
+            self._counters["partitions_granted"] += 1
+            self._m_ds_granted.inc()
+        elif event == "partition_delta":
+            self._counters["partition_deltas"] += 1
+            try:
+                v = int(fields.get("verdict", 2))
+            except (TypeError, ValueError):
+                v = 2
+            self._m_ds_deltas.inc(verdict=_VERDICT_LABEL.get(v, "unknown"))
+            try:
+                self._m_ds_delta_bytes.inc(int(fields.get("bytes", 0)))
+            except (TypeError, ValueError):
+                pass
+        elif event == "partition_done":
+            self._counters["partitions_done"] += 1
+            self._m_ds_done.inc()
+        elif event == "epoch_fence":
+            self._counters["epoch_fences"] += 1
+            op = str(fields.get("op", "other"))
+            if op not in ("grant", "delta", "delta_reply", "done"):
+                op = "other"
+            self._m_ds_fences.inc(op=op)
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)

@@ -1,0 +1,372 @@
+"""Distributed-search gate: one giant job, a fleet of three, one SIGKILL.
+
+Topology under test: 3 verifyd backends (separate processes, unix
+sockets, ``--time-budget 0`` so partition searches are deadline-bounded
+only) behind one in-process ``VerifydRouter`` with a durable
+``--state-dir`` grant ledger.
+
+Scenario, against in-process exhaustive CPU ground truth:
+
+1. **Calibrate** — the oracle (``check_frontier_auto``, unbounded)
+   decides the workload once; its wall time ``T`` sizes the single-node
+   deadline ``D = T/4`` so the gate self-adjusts to machine speed.
+2. **Single-node refusal** — ``submit --deadline D`` through the router
+   must NOT produce a conclusive verdict: the job provably exceeds one
+   node's budget.
+3. **Distributed completion** — ``submit --distributed`` (no deadline)
+   on the same history completes with the oracle's verdict.  Mid-search,
+   once the final segment's partitions are granted, the backend owning
+   an active partition is SIGKILLed: the coordinator must re-grant the
+   dead node's range under a fresh epoch and still finish.
+4. **Ledger closure** — the grant ledger read cold shows the search
+   closed (verdict recorded, zero open grants), and the reply/stats
+   prove at least one re-grant and zero stale-epoch deltas accepted.
+
+Exit 0 when every assertion holds; 1 with failures on stderr.  One JSON
+summary line lands on stdout.  ``make distsearch`` runs this; ``make
+chaos-full`` includes it.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+from helpers import H  # noqa: E402
+
+from s2_verification_tpu.checker.entries import prepare  # noqa: E402
+from s2_verification_tpu.checker.frontier import (  # noqa: E402
+    check_frontier_auto,
+)
+from s2_verification_tpu.checker.oracle import CheckOutcome  # noqa: E402
+from s2_verification_tpu.service.client import (  # noqa: E402
+    VerifydClient,
+    VerifydError,
+)
+from s2_verification_tpu.service.journal import read_grants_cold  # noqa: E402
+from s2_verification_tpu.service.router import (  # noqa: E402
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev  # noqa: E402
+from s2_verification_tpu.utils.events import (  # noqa: E402
+    AppendIndefiniteFailure,
+)
+
+VERDICT = {CheckOutcome.OK: 0, CheckOutcome.ILLEGAL: 1, CheckOutcome.UNKNOWN: 2}
+
+
+def build_workload(rounds: int, k: int, base: int = 41_000) -> str:
+    """``rounds`` rounds of ``k`` concurrent indefinite appends, each
+    closed by a check-tail barrier pinning exactly one more applied
+    record — the candidate-state union multiplies by ~``k`` per round —
+    then one impossible check-tail so the verdict needs the exhaustive
+    search (the beam dead-ends and cannot shortcut an ILLEGAL)."""
+    h = H()
+    for r in range(rounds):
+        ops = [
+            (10 + i, h.call_append(10 + i, [base + 10 * r + i]))
+            for i in range(k)
+        ]
+        for c, op in ops:
+            h.finish(c, op, AppendIndefiniteFailure())
+        h.check_tail_ok(99, tail=r + 1)
+    h.check_tail_ok(99, tail=10_000)  # impossible: at most ``rounds`` applied
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _spawn_backend(name: str, tmp: str) -> subprocess.Popen:
+    sock = os.path.join(tmp, f"{name}.sock")
+    if os.path.exists(sock):
+        os.remove(sock)  # SIGKILL leaves the socket file; serve refuses it
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "s2_verification_tpu",
+            "serve",
+            "-socket",
+            sock,
+            "--workers",
+            "1",
+            "--device",
+            "off",
+            "-no-viz",
+            "--time-budget",
+            "0",
+            "--stats-log",
+            "",
+            "-out-dir",
+            os.path.join(tmp, "viz"),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        cwd=tmp,
+    )
+    deadline = time.monotonic() + 120
+    probe = VerifydClient(sock)
+    while True:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"backend {name} exited rc={proc.returncode} before binding"
+            )
+        try:
+            probe.ping(timeout=1.0)
+            return proc
+        except (VerifydError, OSError):
+            pass
+        if time.monotonic() > deadline:
+            proc.kill()
+            raise RuntimeError(f"backend {name} never answered ping")
+        time.sleep(0.1)
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--rounds", type=int, default=7,
+        help="branching rounds (union ~ k^rounds; default 7)",
+    )
+    ap.add_argument(
+        "--branch", type=int, default=4,
+        help="concurrent appends per round (default 4)",
+    )
+    args = ap.parse_args()
+
+    failures: list[str] = []
+    summary: dict = {}
+    procs: dict[str, subprocess.Popen] = {}
+    tmp = tempfile.mkdtemp(prefix="distsearch-")
+    t0 = time.monotonic()
+    try:
+        # Phase 1: oracle ground truth + self-calibrated deadline.
+        text = build_workload(args.rounds, args.branch)
+        hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+        t_or = time.monotonic()
+        oracle = check_frontier_auto(hist, witness=False)
+        t_oracle = time.monotonic() - t_or
+        want = VERDICT[oracle.outcome]
+        deadline_s = max(1.5, t_oracle / 4)
+        summary["oracle"] = {
+            "verdict": want,
+            "wall_s": round(t_oracle, 2),
+            "ops": len(hist.ops),
+        }
+        print(
+            f"# oracle: verdict={want} in {t_oracle:.1f}s over "
+            f"{len(hist.ops)} ops; single-node deadline={deadline_s:.1f}s",
+            file=sys.stderr,
+        )
+        if oracle.outcome == CheckOutcome.UNKNOWN:
+            failures.append("oracle inconclusive: workload mis-sized")
+            raise SystemExit  # nothing downstream can be asserted
+
+        names = ("a", "b", "c")
+        for n in names:
+            procs[n] = _spawn_backend(n, tmp)
+        print(f"# backends up: {', '.join(names)}", file=sys.stderr)
+
+        listen = os.path.join(tmp, "router.sock")
+        cfg = RouterConfig(
+            listen=listen,
+            backends=tuple(
+                BackendSpec(n, os.path.join(tmp, f"{n}.sock")) for n in names
+            ),
+            probe_interval_s=0.3,
+            breaker_failures=2,
+            breaker_reset_s=1.0,
+            state_dir=os.path.join(tmp, "router-state"),
+            distsearch_straggler_s=30.0,
+        )
+        with VerifydRouter(cfg) as router:
+            client = VerifydClient(listen)
+
+            # Phase 2: the job provably exceeds one node's deadline.
+            t_single = time.monotonic()
+            single: dict | None = None
+            try:
+                single = client.submit(
+                    text,
+                    client="distsearch-single",
+                    no_viz=True,
+                    deadline_s=deadline_s,
+                    timeout=deadline_s * 8,
+                )
+            except VerifydError as e:
+                print(f"# single-node refused: {e.cls}", file=sys.stderr)
+                summary["single_node"] = {
+                    "error": e.cls,
+                    "wall_s": round(time.monotonic() - t_single, 2),
+                }
+            if single is not None:
+                summary["single_node"] = {
+                    "verdict": single.get("verdict"),
+                    "wall_s": round(time.monotonic() - t_single, 2),
+                }
+                if single.get("verdict") == want:
+                    failures.append(
+                        f"single node finished within deadline {deadline_s:.1f}s"
+                        " — workload too small to need the fleet"
+                    )
+
+            # Phase 3: distributed, with a SIGKILL once the search is
+            # deep enough that the victim provably owns live work.
+            killed: dict = {}
+
+            def _assassin() -> None:
+                stop_at = time.monotonic() + 600
+                while time.monotonic() < stop_at:
+                    try:
+                        ds = client.stats(timeout=5).get("distsearch") or {}
+                    except (VerifydError, OSError):
+                        time.sleep(0.1)
+                        continue
+                    active = ds.get("active") or {}
+                    owners = {
+                        part: node
+                        for parts in active.values()
+                        for part, node in parts.items()
+                    }
+                    # Wait past the first segments: by the 5th grant the
+                    # final (largest) segment's partitions are out, each
+                    # seconds long — the kill lands mid-partition.
+                    if ds.get("granted", 0) >= 5 and owners:
+                        part, node = sorted(owners.items())[0]
+                        proc = procs.get(node)
+                        if proc is not None and proc.poll() is None:
+                            os.kill(proc.pid, signal.SIGKILL)
+                            proc.wait()
+                            killed["node"] = node
+                            killed["part"] = part
+                            killed["granted_at_kill"] = ds.get("granted")
+                            print(
+                                f"# SIGKILL {node} owning partition {part} "
+                                f"({ds.get('granted')} grants issued)",
+                                file=sys.stderr,
+                            )
+                        return
+                    time.sleep(0.1)
+
+            assassin = threading.Thread(target=_assassin, daemon=True)
+            assassin.start()
+            t_dist = time.monotonic()
+            reply = client.submit(
+                text,
+                client="distsearch-fleet",
+                no_viz=True,
+                distributed=True,
+                timeout=600,
+            )
+            dist_wall = time.monotonic() - t_dist
+            assassin.join(timeout=10)
+
+            if reply.get("verdict") != want:
+                failures.append(
+                    f"distributed verdict {reply.get('verdict')} != "
+                    f"oracle {want}"
+                )
+            if not reply.get("distributed"):
+                failures.append(
+                    "reply not distributed: the route fell back single-node"
+                )
+            if not killed:
+                failures.append("assassin never fired: no backend SIGKILLed")
+            if reply.get("regrants", 0) < 1:
+                failures.append(
+                    f"no re-grant recorded ({reply.get('regrants')}) — the "
+                    "dead node's range was never provably re-owned"
+                )
+            if reply.get("stale_accepted", 0) != 0:
+                failures.append(
+                    f"{reply.get('stale_accepted')} stale-epoch deltas "
+                    "accepted (must be zero)"
+                )
+            stats = client.stats()
+            ds_stats = stats.get("distsearch") or {}
+            if ds_stats.get("regranted", 0) < 1:
+                failures.append("router counters show zero re-grants")
+            summary["distributed"] = {
+                "verdict": reply.get("verdict"),
+                "wall_s": round(dist_wall, 2),
+                "partitions": reply.get("partitions"),
+                "grants": reply.get("grants"),
+                "regrants": reply.get("regrants"),
+                "steals": reply.get("steals"),
+                "fences": reply.get("fences"),
+                "stale_accepted": reply.get("stale_accepted"),
+                "owners": reply.get("owners"),
+                "killed": killed,
+            }
+            print(
+                f"# distributed: verdict={reply.get('verdict')} in "
+                f"{dist_wall:.1f}s — {reply.get('partitions')} partitions, "
+                f"{reply.get('grants')} grants, {reply.get('regrants')} "
+                f"regrants, {reply.get('fences')} fences",
+                file=sys.stderr,
+            )
+
+        # Phase 4: the ledger read cold must show a closed search.
+        cold = read_grants_cold(os.path.join(tmp, "router-state"))
+        if cold is None:
+            failures.append("no grant ledger on disk under the state dir")
+        else:
+            if cold["open_total"] != 0:
+                failures.append(
+                    f"{cold['open_total']} grants left open after the verdict"
+                )
+            closed = [
+                s for s in cold["searches"].values()
+                if s["verdict"] is not None
+            ]
+            if not closed:
+                failures.append("ledger never recorded the search verdict")
+            elif closed[0]["verdict"] != want:
+                failures.append(
+                    f"ledger verdict {closed[0]['verdict']} != oracle {want}"
+                )
+            summary["ledger"] = {
+                "open_total": cold["open_total"],
+                "searches": len(cold["searches"]),
+                "recovery": cold["recovery"],
+            }
+    except SystemExit:
+        pass
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    summary["wall_s"] = round(time.monotonic() - t0, 2)
+    summary["failures"] = len(failures)
+    for f in failures:
+        print(f"FAIL: {f}", file=sys.stderr)
+    print(json.dumps({"distsearch_check": summary}, sort_keys=True))
+    if failures:
+        return 1
+    print("# distsearch_check: all assertions hold", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,397 @@
+"""Distributed frontier search: partition canon, epoch fencing, ledger
+recovery, and the end-to-end coordinated route.
+
+Unit layers are clockless and wire-free (pack/unpack byte canon, digest
+partitioning, segment planning, the coordinator's merge fence, ledger
+torn-tail recovery); the end-to-end layer boots three in-process
+``Verifyd`` backends behind an in-process ``VerifydRouter`` and proves
+verdict parity against the in-process CPU oracle — the SIGKILL story
+lives in ``make distsearch`` (scripts/distsearch_check.py).
+"""
+
+import io
+import json
+import os
+import struct
+
+import pytest
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import (
+    check_frontier,
+    check_frontier_auto,
+)
+from s2_verification_tpu.checker.oracle import CheckOutcome
+from s2_verification_tpu.models.stream import INIT_STATE, StreamState
+from s2_verification_tpu.service.client import VerifydClient
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.distsearch import (
+    Coordinator,
+    pack_states,
+    part_ranges,
+    partition_states,
+    plan_segments,
+    unpack_states,
+)
+from s2_verification_tpu.service.journal import (
+    GRANTS_SUBDIR,
+    GrantLedger,
+    read_grants_cold,
+)
+from s2_verification_tpu.service.router import (
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.utils import events as ev
+from s2_verification_tpu.utils.events import AppendIndefiniteFailure
+
+from helpers import H, fold
+
+
+def _text(h: H) -> str:
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _branchy(rounds: int = 3, k: int = 2, base: int = 700) -> H:
+    """``rounds`` rounds of ``k`` concurrent indefinite appends, each
+    closed by a check-tail barrier pinning exactly one more applied
+    record — every round doubles the candidate-state union, and every
+    barrier is an event-closed cut for the segment planner."""
+    h = H()
+    for r in range(rounds):
+        ops = [
+            (10 + i, h.call_append(10 + i, [base + 10 * r + i]))
+            for i in range(k)
+        ]
+        for c, op in ops:
+            h.finish(c, op, AppendIndefiniteFailure())
+        h.check_tail_ok(99, tail=r + 1)
+    return h
+
+
+# -- wire canon ---------------------------------------------------------------
+
+
+def test_pack_unpack_roundtrip_byte_for_byte():
+    states = (
+        StreamState(tail=3, stream_hash=777, fencing_token=None),
+        StreamState(tail=1, stream_hash=42, fencing_token=7),
+        StreamState(tail=2, stream_hash=99, fencing_token=None),
+    )
+    payload = pack_states(states)
+    # JSON round trip (the wire) then re-pack: identical bytes.
+    wire = json.dumps(payload, separators=(",", ":"))
+    back = unpack_states(json.loads(wire))
+    assert set(back) == set(states)
+    assert json.dumps(pack_states(back), separators=(",", ":")) == wire
+    # Input order never matters: the canon sorts.
+    assert pack_states(reversed(states)) == payload
+
+
+def test_unpack_malformed_raises():
+    for bad in ([[1, 2]], [["x", "y", None]], [1], [[1, 2, 3, 4]]):
+        with pytest.raises(ValueError):
+            unpack_states(bad)
+
+
+def test_part_ranges_cover_disjoint():
+    for n in (1, 2, 3, 7, 16):
+        ranges = part_ranges(n)
+        assert len(ranges) == n
+        assert ranges[0][0] == 0 and ranges[-1][1] == 1 << 32
+        for (_, hi), (lo, _) in zip(ranges, ranges[1:]):
+            assert hi == lo  # contiguous, no gap, no overlap
+
+
+def test_partition_states_disjoint_cover():
+    states = [
+        StreamState(tail=t, stream_hash=h, fencing_token=None)
+        for t in range(6)
+        for h in (t * 1000003, t * 17 + 5)
+    ]
+    for n in (1, 2, 3, 5):
+        parts = partition_states(states, n)
+        assert len(parts) <= n
+        assert all(parts.values())  # empty ranges are dropped
+        union = [s for ss in parts.values() for s in ss]
+        assert sorted(union) == sorted(set(states))  # covering
+        seen = set()
+        for ss in parts.values():  # pairwise disjoint
+            assert not (set(ss) & seen)
+            seen.update(ss)
+
+
+# -- segment planning ---------------------------------------------------------
+
+
+def test_plan_segments_cuts_are_event_closed_and_partition_ops():
+    h = _branchy(rounds=4, k=2)
+    events = h.events
+    hist = prepare(events, elide_trivial=True)
+    segments = plan_segments(events, hist, 3)
+    assert segments is not None and 2 <= len(segments) <= 3
+    # Contiguous slices covering every event, op counts increasing to
+    # the full op count.
+    assert segments[0].event_lo == 0
+    assert segments[-1].event_hi == len(events)
+    for a, b in zip(segments, segments[1:]):
+        assert a.event_hi == b.event_lo
+        assert a.ops_hi < b.ops_hi
+    assert segments[-1].ops_hi == len(hist.ops)
+    for seg in segments[:-1]:
+        # Event-closed: every op started in the prefix finished in it.
+        open_ops = set()
+        for le in events[: seg.event_hi]:
+            key = (le.client_id, le.op_id)
+            open_ops.add(key) if le.is_start else open_ops.discard(key)
+        assert not open_ops, f"cut at {seg.event_hi} slices an op"
+        # Op-consistent: ops_hi counts exactly the ops called before it.
+        assert seg.ops_hi == sum(
+            1 for op in hist.ops if op.call < seg.event_hi
+        )
+        # Boundary names come from the chain-hash prefix canon.
+        assert not seg.key.startswith("seg:")
+
+
+def test_plan_segments_degenerate_histories():
+    assert plan_segments([], prepare([]), 3) is None
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    hist = prepare(h.events, elide_trivial=True)
+    segs = plan_segments(h.events, hist, 3)  # no interior cut helps
+    assert segs is not None and len(segs) == 1
+    assert segs[0].event_hi == len(h.events)
+
+
+def test_complete_cuts_holds_early_accept_until_union_is_exact():
+    """A history whose tail is all indefinite appends early-accepts —
+    fine for a verdict, fatal for a partition whose end union seeds the
+    next segment.  ``complete_cuts=True`` defers the accept until the
+    requested cut's union is the exact reachable set."""
+    h = H()
+    a = h.call_append(1, [11])
+    b = h.call_append(2, [12])
+    h.finish(1, a, AppendIndefiniteFailure())
+    h.finish(2, b, AppendIndefiniteFailure())
+    hist = prepare(h.events, elide_trivial=True)
+    n = len(hist.ops)
+    relaxed = check_frontier(hist, witness=False, snapshot_cuts=[n])
+    assert relaxed.outcome == CheckOutcome.OK
+    assert n not in (getattr(relaxed, "snapshots", None) or {})
+    held = check_frontier(
+        hist, witness=False, snapshot_cuts=[n], complete_cuts=True
+    )
+    assert held.outcome == CheckOutcome.OK
+    union = set(getattr(held, "snapshots", {})[n])
+    # Exact: every apply/skip interleaving of the two appends.
+    assert union == {
+        INIT_STATE,
+        StreamState(tail=1, stream_hash=fold([11]), fencing_token=None),
+        StreamState(tail=1, stream_hash=fold([12]), fencing_token=None),
+        StreamState(tail=2, stream_hash=fold([11, 12]), fencing_token=None),
+        StreamState(tail=2, stream_hash=fold([12, 11]), fencing_token=None),
+    }
+
+
+# -- the coordinator's merge fence --------------------------------------------
+
+
+def test_coordinator_fences_stale_and_duplicate_deltas(tmp_path):
+    led = GrantLedger(str(tmp_path / "state" / GRANTS_SUBDIR))
+    coord = Coordinator(search="s-unit", nodes=lambda: [], ledger=led)
+    try:
+        seg, part = "chain:deadbeef", "00000000-80000000"
+        coord._epochs[(seg, part)] = 5
+        body = {"verdict": 0, "states": []}
+        # A zombie's stale epoch is refused, counted, journaled.
+        assert coord._accept_delta(seg, part, 4, body) is False
+        assert coord.fences == 1
+        # An epoch never granted is equally stale.
+        assert coord._accept_delta(seg, "ffffffff-100000000", 5, body) is False
+        # The exact live epoch merges exactly once...
+        assert coord._accept_delta(seg, part, 5, body) is True
+        assert coord._results[(seg, part)] is body
+        # ...and its duplicate is fenced, even at the same epoch.
+        assert coord._accept_delta(seg, part, 5, body) is False
+        assert coord.fences == 3
+        assert coord.stale_accepted == 0
+    finally:
+        coord._pool.shutdown(wait=False)
+        led.close()
+    cold = read_grants_cold(str(tmp_path / "state"))
+    assert cold is not None
+    assert cold["searches"]["s-unit"]["fences"] == 3
+
+
+def test_coordinator_epoch_floor_monotone():
+    coord = Coordinator(search="s", nodes=lambda: [], epoch_floor=41)
+    try:
+        assert coord._next_epoch() == 42  # restart fences the dead boot
+        assert coord._next_epoch() == 43
+    finally:
+        coord._pool.shutdown(wait=False)
+
+
+# -- grant ledger durability --------------------------------------------------
+
+
+def _seed_ledger(directory: str) -> GrantLedger:
+    led = GrantLedger(directory)
+    led.search(search="s1", segs=2, parts=2)
+    led.grant(search="s1", seg="k1", part="p1", epoch=1, node="a", reason="grant")
+    led.grant(search="s1", seg="k1", part="p2", epoch=2, node="b", reason="grant")
+    led.delta(
+        search="s1", seg="k1", part="p1", epoch=1, node="a",
+        verdict=0, states=3, size=64,
+    )
+    led.done(search="s1", seg="k1", part="p1", epoch=1, reason="done")
+    return led
+
+
+def test_grant_ledger_torn_tail_recovers_valid_prefix(tmp_path):
+    directory = str(tmp_path / "ledger")
+    _seed_ledger(directory).close()
+    # Tear the tail: the coordinator died mid-append of the ``done``
+    # record, leaving a valid header and a truncated payload.
+    segs = sorted(p for p in os.listdir(directory) if p.startswith("seg-"))
+    path = os.path.join(directory, segs[-1])
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)
+    led = GrantLedger(directory)
+    orphans, floors = led.recover()
+    # The torn ``done`` is dropped, so p1's grant is open again — exactly
+    # the honest reading: its closure never durably happened.
+    assert sorted(o["part"] for o in orphans) == ["p1", "p2"]
+    assert floors == {"s1": 2}
+    assert led.recovery.torn_tail_bytes > 0
+    assert led.recovery.records == 4
+    # The writer rotates away from the damaged segment; new records land
+    # and survive the next recovery.
+    led.done(search="s1", seg="k1", part="p2", epoch=2, reason="done")
+    led.close()
+    led2 = GrantLedger(directory)
+    orphans2, _ = led2.recover()
+    assert sorted(o["part"] for o in orphans2) == ["p1"]
+    led2.close()
+
+
+def test_grant_ledger_recover_clean(tmp_path):
+    directory = str(tmp_path / "ledger")
+    led = _seed_ledger(directory)
+    orphans, floors = led.recover()
+    assert [o["part"] for o in orphans] == ["p2"]  # p1 closed by done
+    assert floors == {"s1": 2}
+    led.verdict(search="s1", verdict=0, outcome="ok")
+    orphans, _ = led.recover()
+    assert orphans == []  # a verdict closes every record of the search
+    led.close()
+
+
+def test_read_grants_cold_absent_and_present(tmp_path):
+    empty = tmp_path / "no-ledger"
+    empty.mkdir()
+    assert read_grants_cold(str(empty)) is None
+    state = tmp_path / "state"
+    _seed_ledger(str(state / GRANTS_SUBDIR)).close()
+    cold = read_grants_cold(str(state))
+    s = cold["searches"]["s1"]
+    assert s["verdict"] is None  # live at death
+    assert [g["part"] for g in s["open_grants"]] == ["p2"]
+    assert s["last_delta"]["p1"]["verdict"] == 0
+    assert cold["open_total"] == 1
+    assert cold["recovery"]["torn_tail_bytes"] == 0
+
+
+# -- end-to-end: the coordinated route ---------------------------------------
+
+
+def _backend_cfg(tmp_path, name: str) -> VerifydConfig:
+    return VerifydConfig(
+        socket_path=str(tmp_path / f"{name}.sock"),
+        workers=1,
+        device="off",
+        no_viz=True,
+        stats_log=None,
+        out_dir=str(tmp_path / f"viz-{name}"),
+    )
+
+
+def _router_cfg(tmp_path, names, **overrides) -> RouterConfig:
+    kw = dict(
+        listen=str(tmp_path / "router.sock"),
+        backends=tuple(
+            BackendSpec(n, str(tmp_path / f"{n}.sock")) for n in names
+        ),
+        probe_interval_s=30.0,
+        state_dir=str(tmp_path / "router-state"),
+    )
+    kw.update(overrides)
+    return RouterConfig(**kw)
+
+
+def test_distributed_submit_verdict_parity_ok(tmp_path):
+    text = _text(_branchy(rounds=3, k=2, base=900))
+    hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+    oracle = check_frontier_auto(hist)
+    assert oracle.outcome == CheckOutcome.OK
+    names = ("a", "b", "c")
+    with Verifyd(_backend_cfg(tmp_path, "a")), Verifyd(
+        _backend_cfg(tmp_path, "b")
+    ), Verifyd(_backend_cfg(tmp_path, "c")), VerifydRouter(
+        _router_cfg(tmp_path, names)
+    ) as router:
+        client = VerifydClient(router.cfg.listen)
+        reply = client.submit(text, no_viz=True, distributed=True)
+        assert reply["verdict"] == 0
+        assert reply["outcome"] == "ok"
+        assert reply["distributed"] is True
+        assert reply["node"] == "distributed"
+        assert reply["stale_accepted"] == 0
+        # Three segments; the first carries only INIT, later boundaries
+        # carry a branched union split across nodes.
+        assert reply["partitions"] >= 3
+        assert reply["grants"] >= reply["partitions"]
+        assert set(reply["owners"].values()) <= set(names)
+        snap = client.stats()
+        assert snap["distsearch"]["searches"] == 1
+        assert snap["distsearch"]["ledger"] is True
+    # The ledger closed the search: nothing left open post-mortem.
+    cold = read_grants_cold(str(tmp_path / "router-state"))
+    assert cold is not None and cold["open_total"] == 0
+    (search_rec,) = cold["searches"].values()
+    assert search_rec["verdict"] == 0 and search_rec["outcome"] == "ok"
+
+
+def test_distributed_submit_verdict_parity_illegal(tmp_path):
+    h = _branchy(rounds=2, k=2, base=1300)
+    h.check_tail_ok(99, tail=50)  # impossible: at most 2 records applied
+    text = _text(h)
+    hist = prepare(list(ev.iter_history(text)), elide_trivial=True)
+    assert check_frontier_auto(hist).outcome == CheckOutcome.ILLEGAL
+    with Verifyd(_backend_cfg(tmp_path, "a")), Verifyd(
+        _backend_cfg(tmp_path, "b")
+    ), VerifydRouter(_router_cfg(tmp_path, ("a", "b"))) as router:
+        client = VerifydClient(router.cfg.listen)
+        reply = client.submit(text, no_viz=True, distributed=True)
+        assert reply["verdict"] == 1
+        assert reply["outcome"] == "illegal"
+        assert reply["distributed"] is True
+        assert reply["stale_accepted"] == 0
+
+
+def test_distributed_falls_back_on_single_backend(tmp_path):
+    text = _text(_branchy(rounds=2, k=2, base=1700))
+    with Verifyd(_backend_cfg(tmp_path, "a")), VerifydRouter(
+        _router_cfg(tmp_path, ("a",))
+    ) as router:
+        client = VerifydClient(router.cfg.listen)
+        # One healthy node can't host a fleet search: the route degrades
+        # to the plain single-node submit — correct, just not parallel.
+        reply = client.submit(text, no_viz=True, distributed=True)
+        assert reply["verdict"] == 0
+        assert not reply.get("distributed")
+        assert reply["node"] == "a"
+        assert client.stats()["distsearch"]["fallbacks"] == 1
