@@ -106,6 +106,7 @@ def check_batch_native(
     skip=None,
     profile: bool = False,
     on_lane=None,
+    progress=None,
 ) -> list[LaneVerdict]:
     """Run each lane through the native engine without re-encoding.
 
@@ -117,13 +118,25 @@ def check_batch_native(
     ``on_lane(i, verdict)`` fires the moment lane *i* decides, while
     later lanes are still searching — the early-exit hook the batcher
     uses to answer clients lane by lane.
+
+    ``progress`` is an optional per-lane sequence of
+    :class:`.progress.ProgressSink` (or ``None``) aligned with ``lanes``:
+    each lane's heartbeats go to its own sink, so a mega-launch keeps
+    per-job attribution.  The C call is blocking, so each lane offers a
+    baseline before its search and a final sample after it.
     """
     out: list[LaneVerdict] = []
     for i, lane in enumerate(lanes):
+        sink = progress[i] if progress is not None else None
         reason = skip(i) if skip is not None else None
         if reason is not None:
             v = LaneVerdict(None, "batch-native", 0.0, skipped=reason)
         else:
+            total = len(lane.history.ops)
+            if sink is not None:
+                sink.update(
+                    ops_committed=0, total_ops=total, engine="batch-native"
+                )
             t0 = time.monotonic()
             res = check_native(
                 lane.history,
@@ -132,6 +145,19 @@ def check_batch_native(
                 enc=lane.enc,
             )
             v = LaneVerdict(res, "batch-native", time.monotonic() - t0)
+            if sink is not None:
+                done = (
+                    res.linearization
+                    if res.outcome == CheckOutcome.OK
+                    else res.deepest
+                )
+                sink.update(
+                    ops_committed=len(done or []),
+                    total_ops=total,
+                    states_expanded=res.steps,
+                    engine="batch-native",
+                    final=True,
+                )
         out.append(v)
         if on_lane is not None:
             on_lane(i, v)
@@ -160,12 +186,18 @@ def check_batch_vmap(
     lanes: list[BatchLane],
     skip=None,
     capacity: int = VMAP_LANE_CAPACITY,
+    progress=None,
 ) -> list[LaneVerdict]:
     """One vmapped frontier search over the whole launch group.
 
     Lanes must come from one :func:`..models.encode.encode_batch` call
     (shape-identical arrays).  Per-lane verdicts follow the beam
     soundness rules; undecidable lanes return ``result=None``.
+
+    ``progress`` is an optional per-lane sink sequence (see
+    :func:`check_batch_native`).  The whole group is one compiled launch,
+    so each live lane gets a baseline before it and a final sample after,
+    with the lane's own latched layer count.
     """
     n = len(lanes)
     verdicts: list[LaneVerdict | None] = [None] * n
@@ -214,6 +246,14 @@ def check_batch_vmap(
         frontier_list.append(frontier_list[-1])
 
     max_layers = max(lanes[i].enc.total_remaining for i in live) + 2
+    if progress is not None:
+        for i in live:
+            if progress[i] is not None:
+                progress[i].update(
+                    ops_committed=0,
+                    total_ops=len(lanes[i].history.ops),
+                    engine="batch-vmap",
+                )
     t0 = time.monotonic()
     out = _mega_launch(_stack(tables_list), _stack(frontier_list), max_layers)
     stop = np.asarray(out.stop_code)
@@ -230,4 +270,14 @@ def check_batch_vmap(
         else:
             res = None  # pruned dead end / layer cap: escalate this lane
         verdicts[i] = LaneVerdict(res, "batch-vmap", wall, layers=lane_layers)
+        sink = progress[i] if progress is not None else None
+        if sink is not None:
+            total = len(lanes[i].history.ops)
+            sink.update(
+                ops_committed=total if code == STOP_ACCEPT else 0,
+                total_ops=total,
+                layer=lane_layers,
+                engine="batch-vmap",
+                final=True,
+            )
     return verdicts  # type: ignore[return-value]

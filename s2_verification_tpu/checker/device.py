@@ -1515,6 +1515,7 @@ def check_device(
     sort_dedup: bool | None = None,
     device_rows_cap: int = 0,
     pallas_fold: bool | None = None,
+    progress=None,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1577,6 +1578,11 @@ def check_device(
     layer count, segment-max live rows, ops auto-closed, elapsed wall
     seconds, and the stop code.  Spilled searches append one entry per
     out-of-core layer.
+
+    ``progress`` is an optional :class:`.progress.ProgressSink`: the host
+    regains control only at compiled-segment boundaries, so that is the
+    honest heartbeat cadence — one offer per segment, from scalars the
+    driver already fetched.
     """
     del state_slots
     collect_stats = collect_stats or profile
@@ -1901,6 +1907,15 @@ def check_device(
                 entry["shards"] = [int(x) for x in seg_shards]
                 entry["sync_s"] = round(sync_s, 6)
             stats.timeline.append(entry)
+        if progress is not None:
+            progress.update(
+                ops_committed=int(np.asarray(deep_np).sum()),
+                total_ops=enc.num_ops,
+                frontier_width=int(live),
+                states_expanded=stats.expanded,
+                layer=stats.layers,
+                engine="device",
+            )
         deep_counts = deep_np
         if allow_prune:
             stats.pruned = stats.pruned or bool(seg_pruned)
@@ -2887,6 +2902,7 @@ def check_device_auto(
     spill: bool = True,
     spill_host_cap: int = 1 << 26,
     device_rows_cap: int | None = None,
+    progress=None,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
@@ -2957,6 +2973,7 @@ def check_device_auto(
             checkpoint_every=checkpoint_every,
             witness=witness,
             witness_max_frontier=witness_max_frontier,
+            progress=progress,
         )
         if res.outcome != CheckOutcome.UNKNOWN:
             if marker is not None:
@@ -2986,6 +3003,7 @@ def check_device_auto(
         spill=spill,
         spill_host_cap=spill_host_cap,
         device_rows_cap=device_rows_cap,
+        progress=progress,
     )
     # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
     # paired with the kept exhaustive snapshot: a retry (e.g. with a larger

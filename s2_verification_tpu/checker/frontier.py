@@ -146,6 +146,7 @@ def check_frontier(
     snapshot_cuts: Iterable[int] | None = None,
     complete_cuts: bool = False,
     time_budget_s: float | None = None,
+    progress=None,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
 
@@ -188,6 +189,11 @@ def check_frontier(
 
     ``time_budget_s`` bounds the search wall clock (checked per layer);
     expiry returns UNKNOWN, matching the other engines' budget semantics.
+
+    ``progress`` is an optional :class:`.progress.ProgressSink`: each
+    layer offers ``(ops committed, total ops, frontier width, states
+    expanded)`` and the sink time-gates what actually leaves — one clock
+    read per layer on the fast path.
     """
     collect_stats = collect_stats or profile
     ops = history.ops
@@ -379,6 +385,15 @@ def check_frontier(
     while True:
         layer += 1
         stats.layers = layer
+        if progress is not None:
+            progress.update(
+                ops_committed=deep_sum,
+                total_ops=len(ops),
+                frontier_width=len(frontier),
+                states_expanded=stats.expanded,
+                layer=layer,
+                engine="frontier",
+            )
         if deadline is not None and time.monotonic() > deadline:
             _finish_layer()
             res = CheckResult(CheckOutcome.UNKNOWN, deepest=deepest_of(deep_counts))
@@ -514,6 +529,7 @@ def check_frontier_auto(
     init_states: Iterable[StreamState] | None = None,
     snapshot_cuts: Iterable[int] | None = None,
     time_budget_s: float | None = None,
+    progress=None,
 ) -> CheckResult:
     """Beam-first frontier check with exhaustive escalation.
 
@@ -535,6 +551,7 @@ def check_frontier_auto(
         init_states=init_states,
         snapshot_cuts=snapshot_cuts,
         time_budget_s=time_budget_s,
+        progress=progress,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
@@ -548,4 +565,5 @@ def check_frontier_auto(
         init_states=init_states,
         snapshot_cuts=snapshot_cuts,
         time_budget_s=time_budget_s,
+        progress=progress,
     )

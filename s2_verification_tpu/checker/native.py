@@ -107,6 +107,7 @@ def check_native(
     _states_cap: int = 4096,
     profile: bool = False,
     enc: EncodedHistory | None = None,
+    progress=None,
 ) -> CheckResult:
     """Decide linearizability with the native engine.
 
@@ -124,6 +125,11 @@ def check_native(
     lane runner encodes a whole launch group up front) skip the second
     encode; it must be ``encode_history(history)`` output for the same
     history.
+
+    ``progress`` is an optional :class:`.progress.ProgressSink`.  The C
+    search is one blocking call, so only two offers are possible: a rate
+    baseline before the search and a final heartbeat after it (the sink's
+    trivial-job rule keeps fast runs silent).
     """
     lib = _load()
     t_enc0 = _time.monotonic() if profile else 0.0
@@ -212,6 +218,9 @@ def check_native(
             ct.byref(hits),
         )
 
+    if progress is not None:
+        # Rate baseline only (the sink never emits on first offer).
+        progress.update(ops_committed=0, total_ops=n, engine="native")
     t_search0 = _time.monotonic() if profile else 0.0
     rc = invoke(-1.0 if time_budget_s is None else time_budget_s)
     if rc == 0 and states_len.value > states_cap:
@@ -227,6 +236,14 @@ def check_native(
         rc = invoke(-1.0)
         assert rc == 0 and states_len.value <= states_cap
     search_s = (_time.monotonic() - t_search0) if profile else 0.0
+    if progress is not None:
+        progress.update(
+            ops_committed=n if rc == 0 else int(order_len.value),
+            total_ops=n,
+            states_expanded=int(steps.value),
+            engine="native",
+            final=True,
+        )
 
     # Encoded op index → History.ops index (forced-prefix ops were peeled
     # off before encoding).

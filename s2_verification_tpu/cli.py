@@ -1264,7 +1264,145 @@ def _cmd_dash(args: argparse.Namespace) -> int:
             storms = int(snap.get("retrace_storms", 0))
             if storms:
                 lines.append("  !! retrace storms latched: %d" % storms)
+            active_searches = [
+                r
+                for r in (snap.get("progress") or [])
+                if isinstance(r, dict) and not r.get("done")
+            ]
+            for r in active_searches[:8]:
+                eta = r.get("eta_s")
+                lines.append(
+                    "  >> job=%-5s %-11s %5.1f%%  %s/%s ops  eta=%s"
+                    % (
+                        r.get("job"),
+                        r.get("engine") or "?",
+                        100.0 * float(r.get("progress_ratio") or 0.0),
+                        r.get("ops_committed"),
+                        r.get("total_ops"),
+                        "%.0fs" % float(eta) if eta is not None else "?",
+                    )
+                )
             print("\n".join(lines), flush=True)
+            n += 1
+            if args.iterations and n >= args.iterations:
+                return 0
+            time.sleep(max(0.1, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cmd_watch(args: argparse.Namespace) -> int:
+    """`verifyd watch`: live progress board for running searches.
+
+    Polls the ``watch`` op (daemon or router — the router fans out and
+    aggregates distributed partitions) and renders one frame per poll:
+    per-job progress ratio with a climbing sparkline, committed/total
+    ops, frontier width, ops rate and the EWMA-smoothed ETA.  A named
+    selector that was visible and then answers the definite
+    ``UnknownJob`` means the job finished — that's a clean exit, not an
+    error.
+    """
+    from .service.client import (
+        VerifydClient,
+        VerifydError,
+        VerifydUnavailable,
+    )
+    from .service.protocol import (
+        ERR_UNKNOWN_JOB,
+        EXIT_PROTOCOL,
+        EXIT_UNAVAILABLE,
+    )
+
+    try:
+        client = VerifydClient(args.socket, secret=_read_secret(args))
+    except ValueError as e:
+        log.error("%s", e)
+        return USAGE_EXIT
+    import json as _json
+
+    ratios: dict[tuple, list[float]] = {}
+    seen = False
+    n = 0
+    try:
+        while True:
+            try:
+                got = client.watch(
+                    job=args.job,
+                    fingerprint=args.fingerprint,
+                    search=args.search,
+                    part=args.part,
+                )
+            except VerifydUnavailable as e:
+                log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+                return EXIT_UNAVAILABLE
+            except VerifydError as e:
+                if e.cls == ERR_UNKNOWN_JOB:
+                    if seen:
+                        # It was on the board and now is not: it finished.
+                        log.info("watched job left the progress surface (done)")
+                        return 0
+                    log.error("nothing to watch: %s", e.msg)
+                    return EXIT_PROTOCOL
+                log.error("watch refused: %s", e)
+                return EXIT_PROTOCOL
+            except (OSError, TimeoutError) as e:
+                log.error("cannot reach verifyd on %s: %s", args.socket, e)
+                return EXIT_UNAVAILABLE
+
+            rows = [r for r in got.get("progress") or [] if isinstance(r, dict)]
+            seen = seen or bool(rows)
+            if args.json:
+                print(_json.dumps(got, sort_keys=True), flush=True)
+            else:
+                lines = [
+                    "verifyd watch  socket=%s  %d job(s)"
+                    % (args.socket, len(rows))
+                ]
+                for r in rows:
+                    key = (r.get("node"), r.get("job"))
+                    ratio = float(r.get("progress_ratio") or 0.0)
+                    ratios.setdefault(key, []).append(ratio)
+                    ratios[key] = ratios[key][-args.width :]
+                    eta = r.get("eta_s")
+                    lines.append(
+                        "  job=%-5s %-11s %s %5.1f%%  %s/%s ops  "
+                        "width=%-6s rate=%8.1f/s  eta=%s%s"
+                        % (
+                            r.get("job"),
+                            r.get("engine") or "?",
+                            _spark(ratios[key], args.width),
+                            100.0 * ratio,
+                            r.get("ops_committed"),
+                            r.get("total_ops"),
+                            r.get("frontier_width"),
+                            float(r.get("ops_rate") or 0.0),
+                            "%.0fs" % float(eta) if eta is not None else "?",
+                            "  node=%s" % r["node"] if r.get("node") else "",
+                        )
+                    )
+                dist = got.get("distributed")
+                if dist:
+                    lines.append(
+                        "  distributed %s  epoch=%s  %d partition(s)"
+                        % (
+                            str(dist.get("search", ""))[:16],
+                            dist.get("epoch"),
+                            len(dist.get("partitions") or {}),
+                        )
+                    )
+                    for part, row in sorted(
+                        (dist.get("partitions") or {}).items()
+                    ):
+                        lines.append(
+                            "    part %s  node=%s  ops=%s  stalled=%.1fs"
+                            % (
+                                part,
+                                row.get("node"),
+                                row.get("ops_committed"),
+                                float(row.get("stalled_s") or 0.0),
+                            )
+                        )
+                print("\n".join(lines), flush=True)
             n += 1
             if args.iterations and n >= args.iterations:
                 return 0
@@ -2727,6 +2865,85 @@ def build_parser() -> argparse.ArgumentParser:
         help="sparkline width in characters (default 32)",
     )
     da.set_defaults(fn=_cmd_dash)
+
+    w = sub.add_parser(
+        "watch",
+        help="live progress board for running searches: per-job progress "
+        "ratio, committed/total ops, frontier width and ETA from the "
+        "watch op (point it at a daemon, or at a router to watch the "
+        "whole fleet including distributed partitions)",
+    )
+    w.add_argument(
+        "-socket",
+        "--socket",
+        required=True,
+        help="the daemon's (or router's) unix-socket path, or HOST:PORT "
+        "for the authenticated TCP transport (needs --secret-file or "
+        "VERIFYD_SECRET)",
+    )
+    w.add_argument(
+        "--secret-file",
+        default=None,
+        help="file holding the TCP shared secret (whitespace-stripped); "
+        "falls back to the VERIFYD_SECRET environment variable",
+    )
+    w.add_argument(
+        "--job",
+        type=int,
+        default=None,
+        metavar="ID",
+        help="watch one job id (definite UnknownJob when it is not "
+        "running; after it was visible, that means it finished)",
+    )
+    w.add_argument(
+        "--fingerprint",
+        default=None,
+        metavar="FP",
+        help="watch by verdict-cache fingerprint (e.g. the ppart:… key "
+        "of a distributed partition job)",
+    )
+    w.add_argument(
+        "--search",
+        default=None,
+        metavar="SEARCH",
+        help="watch every partition of a distributed search (the search "
+        "id from submit --distributed); against a router this also "
+        "shows the coordinator's per-partition aggregate",
+    )
+    w.add_argument(
+        "--part",
+        default=None,
+        metavar="RANGE",
+        help="narrow --search to one partition range",
+    )
+    w.add_argument(
+        "--json",
+        action="store_true",
+        help="emit one JSON object per poll instead of the board",
+    )
+    w.add_argument(
+        "--interval",
+        type=float,
+        default=1.0,
+        metavar="SECONDS",
+        help="seconds between polls (default 1.0)",
+    )
+    w.add_argument(
+        "--iterations",
+        type=int,
+        default=0,
+        metavar="N",
+        help="stop after N frames (default 0 = run until the watched "
+        "job finishes or interrupted)",
+    )
+    w.add_argument(
+        "--width",
+        type=int,
+        default=32,
+        metavar="COLS",
+        help="sparkline width in characters (default 32)",
+    )
+    w.set_defaults(fn=_cmd_watch)
 
     u = sub.add_parser("submit", help="submit one history to a running verifyd")
     u.add_argument(

@@ -100,10 +100,15 @@ class Dashboard:
         capacity: int = 240,
         time_fn: Callable[[], float] = time.time,
         title: str = "verifyd",
+        progress_fn: Optional[Callable[[], List[dict]]] = None,
     ) -> None:
         self.registry = registry
         self.health = health
         self.sampler = sampler
+        #: zero-arg callable returning per-active-job progress rows
+        #: (service/progress.py JobProgress.rows); sampled on the SAME
+        #: tick as the metric series — no extra thread for the panel
+        self.progress_fn = progress_fn
         self.interval_s = max(0.2, float(interval_s))
         self.title = title
         self._time = time_fn
@@ -160,6 +165,21 @@ class Dashboard:
             "compiles": compile_rate,
             "rss_mb": round(rss / (1 << 20), 2),
         }
+        if self.progress_fn is not None:
+            try:
+                rows = self.progress_fn() or []
+            except Exception:
+                rows = []
+            sample["progress"] = [
+                {
+                    "job": r.get("job"),
+                    "engine": r.get("engine"),
+                    "ratio": float(r.get("progress_ratio") or 0.0),
+                    "eta_s": r.get("eta_s"),
+                }
+                for r in rows[:16]
+                if isinstance(r, dict) and not r.get("done")
+            ]
         with self._lock:
             self._ring.append(sample)
         return sample
@@ -189,6 +209,42 @@ class Dashboard:
 
     # -- read side -----------------------------------------------------------
 
+    @staticmethod
+    def _progress_series(
+        samples: List[dict], cap: int = 8
+    ) -> List[Dict[str, Any]]:
+        """Per-job progress-ratio series across the retained ring.
+
+        Jobs come from the newest sample that carried progress rows
+        (the currently active set); each job's series is its ratio at
+        every retained tick it appeared in, so a long-running search
+        draws a climbing sparkline while short jobs show as blips.
+        """
+        latest: List[dict] = []
+        for s in reversed(samples):
+            if s.get("progress"):
+                latest = s["progress"]
+                break
+        out = []
+        for row in latest[:cap]:
+            job = row.get("job")
+            series = [
+                p["ratio"]
+                for s in samples
+                for p in (s.get("progress") or ())
+                if p.get("job") == job
+            ]
+            out.append(
+                {
+                    "job": job,
+                    "engine": row.get("engine"),
+                    "ratio": row.get("ratio", 0.0),
+                    "eta_s": row.get("eta_s"),
+                    "series": series,
+                }
+            )
+        return out
+
     def payload(self) -> Dict[str, Any]:
         """The /dashboard.json body: retained series, oldest first."""
         with self._lock:
@@ -201,6 +257,7 @@ class Dashboard:
             "series": {
                 key: [s.get(key, 0.0) for s in samples] for key, _, _ in SERIES
             },
+            "progress": self._progress_series(samples),
         }
 
     def render_json(self) -> str:
@@ -226,6 +283,28 @@ class Dashboard:
                 f"{render_sparkline(vals)}</td>"
                 "</tr>"
             )
+        progress_rows = []
+        for p in self._progress_series(samples):
+            eta = p.get("eta_s")
+            eta_txt = f"{float(eta):.0f}s left" if eta is not None else "—"
+            progress_rows.append(
+                "<tr>"
+                f"<td class=\"name\">job {html.escape(str(p['job']))}"
+                f"<span class=\"unit\"> {html.escape(str(p.get('engine') or ''))}"
+                "</span></td>"
+                f"<td class=\"val\">{100.0 * float(p.get('ratio') or 0.0):.1f}"
+                "<span class=\"unit\"> %</span></td>"
+                f"<td class=\"peak\">{html.escape(eta_txt)}</td>"
+                "<td data-series=\"progress\">"
+                f"{render_sparkline(p.get('series') or [])}</td>"
+                "</tr>"
+            )
+        progress_html = ""
+        if progress_rows:
+            progress_html = (
+                "<h1>active searches</h1>"
+                f"<table>{''.join(progress_rows)}</table>"
+            )
         when = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(self._time()))
         return (
             "<!DOCTYPE html>\n"
@@ -248,6 +327,7 @@ class Dashboard:
             "</style></head><body>"
             f"<h1>{html.escape(self.title)} — live dashboard</h1>"
             f"<table>{''.join(rows)}</table>"
+            f"{progress_html}"
             f"<footer>{len(samples)} samples retained · "
             f"{self.interval_s:g}s tick · rendered {when} · "
             "also: <code>/dashboard.json</code>, <code>/metrics</code>, "

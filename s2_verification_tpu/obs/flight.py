@@ -268,6 +268,42 @@ def postmortem(
         ):
             prefix_activity[name] = prefix_activity.get(name, 0) + 1
 
+    # Search progress: the last heartbeat per job from the ring.  A job
+    # with a heartbeat but no later terminal event (done / job_error /
+    # job_cancelled) was mid-search when the daemon died — its last
+    # reported ratio and ETA are the honest "how far did it get".
+    progress_last: Dict[Any, Dict[str, Any]] = {}
+    progress_beats = 0
+    progress_finished: set = set()
+    for ev in events:
+        name = ev.get("ev") or ev.get("event")
+        if name == "search_progress":
+            progress_beats += 1
+            progress_last[ev.get("job")] = ev
+        elif name in ("done", "job_error", "job_cancelled"):
+            progress_finished.add(ev.get("job"))
+    at_death = [
+        {
+            "job": job,
+            "engine": ev.get("engine"),
+            "ops_committed": ev.get("ops_committed"),
+            "total_ops": ev.get("total_ops"),
+            "progress_ratio": ev.get("progress_ratio"),
+            "eta_s": ev.get("eta_s"),
+            "fingerprint": ev.get("fingerprint"),
+            "t": ev.get("t"),
+        }
+        for job, ev in sorted(
+            progress_last.items(), key=lambda kv: str(kv[0])
+        )
+        if job not in progress_finished
+    ]
+    search_progress = {
+        "heartbeats": progress_beats,
+        "jobs": len(progress_last),
+        "in_flight_at_death": at_death,
+    }
+
     return {
         "state_dir": state_dir,
         "records": len(records),
@@ -290,6 +326,7 @@ def postmortem(
         "slo_at_death": slo_at_death,
         "prefix_store": prefix_store,
         "prefix_activity": prefix_activity,
+        "search_progress": search_progress,
         "distsearch": distsearch,
         # Resource timeline before death: keep the tail — the interesting
         # part of an OOM story is the last few minutes, not the first.
@@ -538,6 +575,36 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                     activity.get("prefix_snapshot", 0),
                     activity.get("prefix_refused", 0),
                     activity.get("window_done", 0),
+                )
+            )
+
+    sp = pm.get("search_progress") or {}
+    if sp.get("heartbeats"):
+        add("")
+        add(
+            "-- search progress: %d heartbeat(s) across %d job(s) --"
+            % (sp.get("heartbeats", 0), sp.get("jobs", 0))
+        )
+        stuck = sp.get("in_flight_at_death") or []
+        if not stuck:
+            add("  every heartbeating job reached a terminal event")
+        for row in stuck[:10]:
+            ratio = row.get("progress_ratio")
+            eta = row.get("eta_s")
+            add(
+                "  MID-SEARCH job=%s engine=%s %s/%s ops (%s) eta %s  "
+                "fp=%s  last beat %s"
+                % (
+                    row.get("job"),
+                    row.get("engine"),
+                    row.get("ops_committed"),
+                    row.get("total_ops"),
+                    "%.0f%%" % (100.0 * float(ratio))
+                    if ratio is not None
+                    else "?",
+                    "%.1fs" % float(eta) if eta is not None else "?",
+                    str(row.get("fingerprint") or "")[:20],
+                    _fmt_t(row.get("t")),
                 )
             )
 

@@ -245,6 +245,10 @@ class Batcher:
             trace_id=live[0][0].trace_id,
             tracer=sched.tracer,
         ):
+            # Per-lane progress sinks (prestart attached them): each lane
+            # heartbeats to its own job row, so a mega-launch stays
+            # attributable job by job on the watch surface.
+            sinks = [job.progress_sink for job, _, _ in live]
             if engine == "native":
                 # Lanes resolve one by one as they decide — a decided
                 # lane's client is answered while later lanes still run.
@@ -253,9 +257,10 @@ class Batcher:
                     skip=skip,
                     profile=sched.profile,
                     on_lane=settle,
+                    progress=sinks,
                 )
             else:
-                verdicts = check_batch_vmap(lanes, skip=skip)
+                verdicts = check_batch_vmap(lanes, skip=skip, progress=sinks)
                 for i, v in enumerate(verdicts):
                     settle(i, v)
         t_end = time.monotonic()

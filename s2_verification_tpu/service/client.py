@@ -303,6 +303,33 @@ class VerifydClient:
             req["fingerprint"] = fingerprint
         return self._call(req, timeout=timeout)
 
+    def watch(
+        self,
+        *,
+        job: int | None = None,
+        fingerprint: str | None = None,
+        search: str | None = None,
+        part: str | int | None = None,
+        timeout: float | None = 10.0,
+    ) -> dict:
+        """One-shot progress snapshot of running searches (``watch`` CLI
+        polls this).  Selectors: ``job`` id, verdict-cache
+        ``fingerprint``, distributed ``search`` id (+ optional ``part``),
+        or none for every active job.  A named selector with no live
+        match is the definite ``UnknownJob`` — the job finished, never
+        existed, or lives on another backend (the router fans out and
+        answers for the fleet)."""
+        req: dict = {"op": "watch"}
+        if job is not None:
+            req["job"] = int(job)
+        if fingerprint is not None:
+            req["fingerprint"] = fingerprint
+        if search is not None:
+            req["search"] = search
+        if part is not None:
+            req["part"] = str(part)
+        return self._call(req, timeout=timeout)
+
     def submit(
         self,
         history_text: str | None = None,

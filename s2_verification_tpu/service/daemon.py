@@ -82,6 +82,7 @@ from .protocol import (
     ERR_QUEUE_FULL,
     ERR_SHUTTING_DOWN,
     ERR_TOO_LARGE,
+    ERR_UNKNOWN_JOB,
     MAX_FRAME_BYTES,
     PROTOCOL_VERSION,
     decode_frame,
@@ -231,6 +232,10 @@ class VerifydConfig:
     prefix_cuts: int = 8
     #: prefix-store segment-rotation bound under <state_dir>/prefix/
     prefix_max_segments: int = 8
+    #: progress-heartbeat cadence per job (checker/progress.ProgressSink
+    #: time gate, the `watch` op's data source); <= 0 disables heartbeats
+    #: entirely — engines then run exactly the pre-progress code path
+    progress_interval_s: float = 0.5
     extra: dict = field(default_factory=dict)
 
 
@@ -443,6 +448,14 @@ class Verifyd:
             max_rss_frac=config.max_rss_frac,
             sampler=self.sampler,
         )
+        self.progress = None
+        if config.progress_interval_s > 0:
+            from .progress import JobProgress
+
+            self.progress = JobProgress(
+                interval_s=config.progress_interval_s,
+                on_heartbeat=self._emit_progress,
+            )
         self.scheduler = Scheduler(
             self.queue,
             self.cache,
@@ -467,6 +480,7 @@ class Verifyd:
             batching=config.batching,
             batch_engine=config.batch_engine,
             prefix_store=self.prefix,
+            progress=self.progress,
         )
         self._job_ids = itertools.count(1)
         #: distributed-search partition grants: (search, part) -> epoch.
@@ -506,6 +520,11 @@ class Verifyd:
                     sampler=self.sampler,
                     interval_s=self.cfg.dashboard_sample_s,
                     capacity=self.cfg.dashboard_capacity,
+                    progress_fn=(
+                        self.progress.rows
+                        if self.progress is not None
+                        else None
+                    ),
                 ).start()
             self._metrics_server = MetricsServer(
                 self.registry,
@@ -917,7 +936,11 @@ class Verifyd:
                 if self.sampler is not None:
                     introspection["resources"] = self.sampler.snapshot()
                 snap["introspection"] = introspection
+                if self.progress is not None:
+                    snap["progress"] = self.progress.rows()
                 return ok(snap)
+            if op == "watch":
+                return self._watch(req)
             if op == "trace":
                 return ok(self.tracer.export())
             if op == "profiles":
@@ -1015,6 +1038,74 @@ class Verifyd:
         except Exception as e:  # protocol handler must never kill the loop
             log.exception("dispatch failed for op %r", op)
             return err(ERR_INTERNAL, repr(e))
+
+    def _emit_progress(self, row: dict) -> None:
+        """JobProgress heartbeat hook → the ``search_progress`` event
+        (flight ring, metrics, archive all ride the normal emit path)."""
+        self.stats.emit(
+            "search_progress",
+            job=row["job"],
+            engine=row["engine"],
+            ops_committed=row["ops_committed"],
+            total_ops=row["total_ops"],
+            frontier_width=row["frontier_width"],
+            states_expanded=row["states_expanded"],
+            layer_rate=row["layer_rate"],
+            progress_ratio=row["progress_ratio"],
+            eta_s=row["eta_s"],
+            fingerprint=row["fingerprint"],
+            trace_id=row["trace_id"],
+        )
+
+    def _watch(self, req: dict) -> dict:
+        """One-shot progress snapshot of running (or just-done) searches.
+
+        Selectors: ``job`` (one id), ``fingerprint`` (verdict-cache key;
+        how a distsearch coordinator polls its ``ppart:`` partition
+        jobs), ``search`` (+ optional ``part``: every partition of a
+        distributed search running here), or none (all active jobs).  A
+        named selector with no match is the definite
+        :data:`~.protocol.ERR_UNKNOWN_JOB` — the router forwards it
+        rather than failing over."""
+        if self.progress is None:
+            return err(
+                ERR_DECODE,
+                "progress heartbeats disabled (progress_interval_s <= 0)",
+            )
+        if req.get("job") is not None:
+            try:
+                job = int(req["job"])
+            except (TypeError, ValueError):
+                return err(ERR_DECODE, "job must be an int")
+            row = self.progress.get(job)
+            if row is None:
+                return err(
+                    ERR_UNKNOWN_JOB, f"job {job} is not running here", job=job
+                )
+            return ok({"progress": [row]})
+        if req.get("fingerprint") is not None:
+            fp = str(req["fingerprint"])
+            rows = self.progress.find(fp)
+            if not rows:
+                return err(
+                    ERR_UNKNOWN_JOB, f"no running job for fingerprint {fp!r}"
+                )
+            return ok({"progress": rows})
+        if req.get("search") is not None:
+            search = str(req["search"])
+            rows = self.progress.find(f"ppart:{search[:16]}/", prefix=True)
+            if req.get("part") is not None:
+                part = str(req["part"])
+                rows = [
+                    r for r in rows if r["fingerprint"].rsplit("/", 1)[-1] == part
+                ]
+            if not rows:
+                return err(
+                    ERR_UNKNOWN_JOB,
+                    f"no partition of search {search[:16]!r} runs here",
+                )
+            return ok({"progress": rows})
+        return ok({"progress": self.progress.rows()})
 
     def _decode_history(
         self, text, records, client: str

@@ -259,6 +259,10 @@ class DistSearchConfig:
     #: coordinator-owned wire threads (grants are synchronous and cheap;
     #: deltas block one thread each until the backend decides)
     io_workers: int = 8
+    #: seconds between owner progress polls (``watch`` by partition
+    #: fingerprint; 0 disables polling and stealing degrades to the
+    #: legacy pure-wall-clock rule)
+    progress_poll_s: float = 1.0
 
 
 @dataclass
@@ -268,6 +272,16 @@ class _Attempt:
     node: str
     future: object
     started: float = field(default_factory=time.monotonic)
+    #: last observed (ops_committed, states_expanded) from the owner's
+    #: watch surface; -1 = no heartbeat seen yet for this attempt
+    ops: int = -1
+    expanded: int = -1
+    #: last time the observation *advanced* — the stall clock.  Starts
+    #: at grant time, so an owner that never reports degrades exactly
+    #: to the legacy started-based wall-clock rule.
+    last_advance: float = field(default_factory=time.monotonic)
+    next_poll: float = 0.0
+    poll_future: object = None
 
 
 class Coordinator:
@@ -311,6 +325,7 @@ class Coordinator:
         self.fences = 0
         self.regrants = 0
         self.steals = 0
+        self.stall_steals = 0
         self.grants = 0
         self.stale_accepted = 0  # structurally zero; asserted by the gate
         self.delta_bytes = 0
@@ -318,6 +333,9 @@ class Coordinator:
         #: reads this to pick its SIGKILL victim)
         self.active: dict[str, str] = {}
         self.owners: dict[str, str] = {}
+        #: part id -> last progress row polled off the owning backend
+        #: (router's ``watch --search`` aggregation reads this)
+        self.progress: dict[str, dict] = {}
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, self.cfg.io_workers),
             thread_name_prefix="distsearch-io",
@@ -461,6 +479,7 @@ class Coordinator:
             "grants": self.grants,
             "regrants": self.regrants,
             "steals": self.steals,
+            "stall_steals": self.stall_steals,
             "fences": self.fences,
             "stale_accepted": self.stale_accepted,
             "epochs": self._epoch,
@@ -492,11 +511,16 @@ class Coordinator:
         if reason == "regrant":
             self.regrants += 1
             self._count("regranted")
-        elif reason == "steal":
+        elif reason in ("steal", "stall-steal"):
             self.steals += 1
             self._count("stolen")
+            if reason == "stall-steal":
+                self.stall_steals += 1
+                self._count("stall_stolen")
         self.active[part] = node_name
         self.owners[part] = node_name
+        with self._lock:
+            self.progress.pop(part, None)  # the new owner's rows replace it
         carry = PrefixCarry(ops=0, states=tuple(states)).to_payload()
         remaining = self.cancel.remaining()
         tmo = self.cfg.attempt_timeout_s
@@ -548,6 +572,77 @@ class Coordinator:
                     pass
             self._pool.submit(_bye)
             break
+
+    def progress_snapshot(self) -> dict:
+        """Per-partition progress aggregate for the router's ``watch``
+        surface: owner, epoch and the last row polled off each owner."""
+        with self._lock:
+            parts = {p: dict(r) for p, r in self.progress.items()}
+        return {
+            "search": self.search,
+            "epoch": self._epoch,
+            "owners": dict(self.owners),
+            "partitions": parts,
+        }
+
+    def _poll_progress(self, a: _Attempt, now: float) -> None:
+        """Non-blocking progress poll of one attempt's owner.
+
+        Harvests the previous poll's answer (advancing the attempt's
+        stall clock when ``ops_committed``/``states_expanded`` moved),
+        then launches the next at ``progress_poll_s`` cadence on the
+        coordinator's own executor — the wait loop never blocks on a
+        watch round-trip.  Owners that answer ``UnknownJob`` (progress
+        disabled, job not yet admitted) simply never advance the clock.
+        """
+        if self.cfg.progress_poll_s <= 0:
+            return
+        fut = a.poll_future
+        if fut is not None:
+            if not fut.done():
+                return
+            a.poll_future = None
+            row = None
+            try:
+                got = fut.result()
+                rows = got.get("progress") or []
+                if rows and isinstance(rows[0], dict):
+                    row = rows[0]
+            except Exception:
+                row = None
+            if row is not None:
+                ops = int(row.get("ops_committed") or 0)
+                expanded = int(row.get("states_expanded") or 0)
+                if ops > a.ops or expanded > a.expanded:
+                    a.last_advance = now
+                a.ops = max(a.ops, ops)
+                a.expanded = max(a.expanded, expanded)
+                with self._lock:
+                    self.progress[a.part] = {
+                        "node": a.node,
+                        "epoch": a.epoch,
+                        "ops_committed": a.ops,
+                        "total_ops": row.get("total_ops"),
+                        "states_expanded": a.expanded,
+                        "progress_ratio": row.get("progress_ratio"),
+                        "eta_s": row.get("eta_s"),
+                        "layer_rate": row.get("layer_rate"),
+                        "stalled_s": round(now - a.last_advance, 3),
+                    }
+        if now < a.next_poll:
+            return
+        a.next_poll = now + self.cfg.progress_poll_s
+        client = next(
+            (c for n, c in self._healthy() if n == a.node), None
+        )
+        if client is None:
+            return
+        fp = f"ppart:{self.search[:16]}/{a.part}"
+
+        def _ask(c=client, key=fp):
+            return c.watch(fingerprint=key, timeout=5.0)
+
+        a.poll_future = self._pool.submit(_ask)
 
     def _harvest_zombie(self, seg: Segment, attempt: _Attempt) -> None:
         """A superseded attempt's eventual reply must still hit the fence
@@ -697,27 +792,53 @@ class Coordinator:
                         node[0], node[1], "regrant",
                         want_union=not final,
                     )
-                elif (
-                    self.cfg.straggler_s > 0
-                    and now - a.started > self.cfg.straggler_s
-                    and regrants_left[part] > 0
-                ):
-                    # Straggler steal: only onto an *idle* healthy node —
-                    # re-running the same work on an equally busy node
-                    # would just double the load.
-                    busy = {x.node for x in attempts.values()}
-                    idle = [
-                        c for c in self._healthy() if c[0] not in busy
-                    ]
-                    if idle:
-                        regrants_left[part] -= 1
-                        self._revoke(seg, a, "revoked")
-                        self._harvest_zombie(seg, a)
-                        attempts[part] = self._grant_and_ship(
-                            seg, seg_text, part, parts[part],
-                            idle[0][0], idle[0][1], "steal",
-                            want_union=not final,
-                        )
+                else:
+                    self._poll_progress(a, now)
+                    # Stall clock: the straggler budget runs from the
+                    # owner's last *progress advance*, not its grant
+                    # time — a slow-but-advancing partition is left
+                    # alone; one whose reported search stopped moving
+                    # is stolen even if a faster sibling keeps the
+                    # coordinator busy.  Owners that never report
+                    # degrade to the legacy wall-clock rule
+                    # (last_advance stays at grant time).
+                    if (
+                        self.cfg.straggler_s > 0
+                        and now - a.last_advance > self.cfg.straggler_s
+                        and regrants_left[part] > 0
+                    ):
+                        # Steal only onto an *idle* healthy node —
+                        # re-running the same work on an equally busy
+                        # node would just double the load.
+                        busy = {x.node for x in attempts.values()}
+                        idle = [
+                            c for c in self._healthy() if c[0] not in busy
+                        ]
+                        if idle:
+                            saw_progress = a.ops >= 0 or a.expanded >= 0
+                            reason = (
+                                "stall-steal" if saw_progress else "steal"
+                            )
+                            log.info(
+                                "partition %s on %s %s for %.1fs; "
+                                "%s to %s",
+                                part,
+                                a.node,
+                                "made no search progress"
+                                if saw_progress
+                                else "straggling",
+                                now - a.last_advance,
+                                reason,
+                                idle[0][0],
+                            )
+                            regrants_left[part] -= 1
+                            self._revoke(seg, a, "revoked")
+                            self._harvest_zombie(seg, a)
+                            attempts[part] = self._grant_and_ship(
+                                seg, seg_text, part, parts[part],
+                                idle[0][0], idle[0][1], reason,
+                                want_union=not final,
+                            )
         if failed_reason is not None:
             return failed_reason, 2
         # merge: exactly one accepted delta per partition (the fence

@@ -166,6 +166,7 @@ __all__ = [
     "ERR_FRONTIER",
     "ERR_QUARANTINED",
     "ERR_CANCELLED",
+    "ERR_UNKNOWN_JOB",
     "EXIT_BUSY",
     "EXIT_UNAVAILABLE",
     "EXIT_PROTOCOL",
@@ -223,6 +224,11 @@ ERR_NO_BACKEND = "NoBackend"
 #: the same check once more at merge time — retrying the stale epoch is
 #: pointless, the partition already belongs to a newer grant.
 ERR_EPOCH = "EpochFenced"
+#: Definite: a ``watch`` frame named a job (or fingerprint / search) this
+#: node is not running and does not remember finishing.  Retrying the
+#: same selector on the same node is pointless; the router treats it as a
+#: semantic answer, not a reason to fail over.
+ERR_UNKNOWN_JOB = "UnknownJob"
 
 #: check-CLI exit code per outcome value (cli.py docstring contract).
 VERDICT_EXIT = {"ok": 0, "illegal": 1, "unknown": 2}
@@ -284,6 +290,18 @@ FRAME_FIELDS = {
     },
     "shutdown": {"drain": "optional", "timeout": "optional"},
     "quarantine": {"action": "optional", "fingerprint": "optional"},
+    # Live progress snapshot of running searches.  All selectors optional
+    # (old-peer interop): no selector = every active job on the node;
+    # ``job`` = one job id; ``fingerprint`` = jobs keyed by verdict-cache
+    # fingerprint (how a coordinator polls its ``ppart:`` partition jobs);
+    # ``search``(+``part``) = a distributed search's partitions, resolved
+    # by the router against its live coordinator or fanned out.
+    "watch": {
+        "job": "optional",
+        "fingerprint": "optional",
+        "search": "optional",
+        "part": "optional",
+    },
     "drain": {"node": "required", "timeout": "optional"},
     "undrain": {"node": "required"},
     # Distributed-search ops (coordinator → backend; service/distsearch.py).

@@ -70,6 +70,9 @@ class Job:
     #: ``kind == "window"`` jobs are follow deltas whose verdicts are
     #: window-scoped: never journaled, never verdict-cached.
     prefix: Any = None
+    #: live progress heartbeat sink (checker/progress.ProgressSink),
+    #: attached by scheduler._prestart; None = heartbeats disabled
+    progress_sink: Any = None
 
 
 class AdmissionQueue:

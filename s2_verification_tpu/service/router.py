@@ -89,6 +89,7 @@ from .protocol import (
     ERR_QUEUE_FULL,
     ERR_SHUTTING_DOWN,
     ERR_TOO_LARGE,
+    ERR_UNKNOWN_JOB,
     MAX_FRAME_BYTES,
     PROTOCOL_VERSION,
     decode_frame,
@@ -365,6 +366,7 @@ class VerifydRouter:
             "fenced": 0,
             "delta_bytes": 0,
             "fallbacks": 0,
+            "stall_stolen": 0,
             "orphans_recovered": ds_orphans,
         }
 
@@ -458,6 +460,11 @@ class VerifydRouter:
         self._m_ds_fallbacks = r.counter(
             "verifyd_distsearch_fallbacks_total",
             "Distributed submits degraded to the single-node route",
+        )
+        self._m_ds_stall_stolen = r.counter(
+            "verifyd_distsearch_partitions_stall_stolen_total",
+            "Partitions stolen because their owner's reported search "
+            "progress stalled (vs. plain slowest-wall-clock steals)",
         )
         for name in names:
             self._m_up.set(0, backend=name)
@@ -772,6 +779,10 @@ class VerifydRouter:
             if op == "follow":
                 return await self._loop.run_in_executor(
                     self._pool, functools.partial(self._route_follow, req)
+                )
+            if op == "watch":
+                return await self._loop.run_in_executor(
+                    self._pool, functools.partial(self._route_watch, req)
                 )
             return err(ERR_DECODE, f"unknown op {op!r}")
         except Exception as e:  # handler must never kill the loop
@@ -1143,6 +1154,7 @@ class VerifydRouter:
             "regranted": self._m_ds_regranted,
             "fenced": self._m_ds_fences,
             "delta_bytes": self._m_ds_delta_bytes,
+            "stall_stolen": self._m_ds_stall_stolen,
         }.get(kind)
         if metric is not None:
             metric.inc(n)
@@ -1444,6 +1456,74 @@ class VerifydRouter:
             f"no backend answered after {attempts} attempts ({last_err})",
             attempts=attempts,
         )
+
+    def _route_watch(self, req: dict) -> dict:
+        """Fan a ``watch`` out across the fleet and merge the rows.
+
+        Progress lives wherever the job runs, and the router cannot know
+        where from a job id alone (ids are per-daemon), so every
+        routable backend is asked and each returned row is tagged with
+        its node.  A backend's ``UnknownJob`` is a *definite* per-node
+        answer — never a failover trigger — it just means "not here".
+        Only when a named selector finds no row anywhere does the router
+        itself answer ``UnknownJob``.
+
+        For an in-flight distributed search the coordinator's own
+        per-partition aggregate (owner, epoch, last reported progress,
+        stall clock) is stitched in as ``distributed`` — the per-backend
+        ``ppart:`` rows and the coordinator view describe the same
+        search from both ends of the wire.
+        """
+        selector = {
+            k: req.get(k)
+            for k in ("job", "fingerprint", "search", "part")
+            if req.get(k) is not None
+        }
+        named = bool(selector)
+        rows: List[dict] = []
+        reachable = 0
+        for name in sorted(self._backends):
+            b = self._backends[name]
+            if not b.routable():
+                continue
+            try:
+                got = b.client.watch(timeout=5.0, **selector)
+            except VerifydError as e:
+                if e.cls == ERR_UNKNOWN_JOB:
+                    reachable += 1
+                continue
+            except OSError:
+                continue
+            reachable += 1
+            for row in got.get("progress") or ():
+                if isinstance(row, dict):
+                    row = dict(row)
+                    row["node"] = name
+                    rows.append(row)
+        reply: Dict[str, Any] = {"progress": rows}
+        search = req.get("search")
+        if search is not None:
+            with self._lock:
+                coords = [
+                    c
+                    for fp, c in self._ds_active.items()
+                    if fp.startswith(str(search))
+                ]
+            for coord in coords:
+                snap = getattr(coord, "progress_snapshot", None)
+                if snap is not None:
+                    reply["distributed"] = snap()
+                    break
+        if named and not rows and "distributed" not in reply:
+            if reachable == 0:
+                self._bump("no_backend")
+                self._m_no_backend.inc()
+                return err(ERR_NO_BACKEND, "no routable backend to watch")
+            return err(
+                ERR_UNKNOWN_JOB,
+                f"no backend is running a job matching {selector!r}",
+            )
+        return ok(reply)
 
     def _bump(self, key: str) -> None:
         with self._lock:

@@ -19,6 +19,7 @@ the client can always rerun one-shot with ``-time-budget 0``.
 
 from __future__ import annotations
 
+import inspect
 import logging
 import os
 import tempfile
@@ -55,16 +56,43 @@ def shape_key(hist: History) -> str:
 
 
 def _cpu_check(
-    hist: History, budget: float | None, profile: bool = False
+    hist: History, budget: float | None, profile: bool = False, progress=None
 ) -> tuple[CheckResult, str]:
     """Native engine when buildable, Python oracle otherwise (cli.py)."""
     from ..checker.native import NativeUnavailable, check_native
 
     try:
-        return check_native(hist, time_budget_s=budget, profile=profile), "native"
+        return (
+            check_native(
+                hist, time_budget_s=budget, profile=profile, progress=progress
+            ),
+            "native",
+        )
     except NativeUnavailable as e:
         log.debug("native checker unavailable (%s); using the Python oracle", e)
         return check(hist, time_budget_s=budget), "oracle"
+
+
+_accepts_progress_cache: tuple = (None, False)
+
+
+def _accepts_progress(fn) -> bool:
+    """Whether ``fn`` takes a ``progress`` kwarg.  Test doubles replace
+    :func:`_cpu_check` with plain ``(hist, budget)`` callables; the sink
+    is only threaded through when the live function can carry it.  The
+    answer is cached per function identity: this runs on every job, and
+    ``inspect.signature`` is tens of microseconds — real money at
+    hundreds of jobs/s."""
+    global _accepts_progress_cache
+    cached_fn, cached = _accepts_progress_cache
+    if cached_fn is fn:
+        return cached
+    try:
+        ok = "progress" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        ok = False
+    _accepts_progress_cache = (fn, ok)
+    return ok
 
 
 def job_profile(res: CheckResult) -> dict:
@@ -120,6 +148,7 @@ class Scheduler:
         batching: bool = False,
         batch_engine: str = "auto",
         prefix_store=None,
+        progress=None,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -164,6 +193,9 @@ class Scheduler:
         #: PrefixPlan run the resumable host-frontier path and write their
         #: snapshot cuts here on OK
         self.prefix_store = prefix_store
+        #: per-job progress table (service/progress.JobProgress); None
+        #: disables heartbeats — every job then runs exactly as before
+        self.progress = progress
         self._batcher = None
         if batching:
             from .batcher import Batcher
@@ -221,6 +253,8 @@ class Scheduler:
                     reply = err("InternalError", repr(e), job=job.id)
                     # Close the journal record even on failure: a poison
                     # job must not re-run on every restart forever.
+                    if self.progress is not None:
+                        self.progress.finish(job.id, outcome="error")
                     self._mark_done(job, verdict=None, outcome="error")
                     # Balance the `start` event so in-flight accounting
                     # (active-jobs gauge, retry-after hint) can't leak.
@@ -276,6 +310,8 @@ class Scheduler:
         """Answer a cancelled job: close its journal record (the client
         got — or abandoned — its reply; nothing is owed a replay), count
         it, and return the definite error."""
+        if self.progress is not None:
+            self.progress.finish(job.id, outcome="cancelled")
         self._mark_done(job, verdict=None, outcome="cancelled")
         self.stats.emit(
             "job_cancelled",
@@ -370,6 +406,13 @@ class Scheduler:
                 tid=job.id,
                 args={"trace_id": job.trace_id},
             )
+        if self.progress is not None:
+            job.progress_sink = self.progress.sink_for(
+                job.id,
+                fingerprint=job.fingerprint,
+                shape=job.shape,
+                trace_id=job.trace_id,
+            )
         return None, queue_wait, warm
 
     def _run_job(self, job: Job) -> dict:
@@ -426,6 +469,8 @@ class Scheduler:
         reason = job.cancel.check()
         if reason is not None and res.outcome == CheckOutcome.UNKNOWN:
             return self._cancel_reply(job, reason, queue_wait, started=True)
+        if self.progress is not None:
+            self.progress.finish(job.id, outcome=res.outcome.value)
         if self.quarantine is not None and res.outcome != CheckOutcome.UNKNOWN:
             # A conclusive verdict forgives accumulated crash counts.
             self.quarantine.note_success(job.fingerprint)
@@ -601,6 +646,7 @@ class Scheduler:
                 # the cut's union is exact.
                 complete_cuts=bool(plan.snap_keys),
                 time_budget_s=budget,
+                progress=job.progress_sink,
             )
         else:
             res = check_frontier_auto(
@@ -612,6 +658,7 @@ class Scheduler:
                 init_states=init_states,
                 snapshot_cuts=sorted(plan.snap_keys) or None,
                 time_budget_s=budget,
+                progress=job.progress_sink,
             )
         self.tracer.add_span(
             f"search.{mode}",
@@ -686,12 +733,15 @@ class Scheduler:
         if job.prefix is not None:
             return self._traced_prefix(job, budget)
         t0 = time.monotonic()
-        # profile only when asked: test doubles for _cpu_check keep the
-        # plain (hist, budget) signature.
+        # Optional kwargs only when asked/armed: test doubles for
+        # _cpu_check keep the plain (hist, budget) signature, so the sink
+        # rides only when the live function declares the kwarg.
+        kw = {}
         if self.profile:
-            res, engine = _cpu_check(job.hist, budget, profile=True)
-        else:
-            res, engine = _cpu_check(job.hist, budget)
+            kw["profile"] = True
+        if job.progress_sink is not None and _accepts_progress(_cpu_check):
+            kw["progress"] = job.progress_sink
+        res, engine = _cpu_check(job.hist, budget, **kw)
         self.tracer.add_span(
             f"cpu[{engine}]",
             t0,
@@ -820,6 +870,8 @@ class Scheduler:
                 kw = {} if self.device_rows is None else {"device_rows_cap": self.device_rows}
                 if self.profile:
                     kw["profile"] = True
+                if job.progress_sink is not None:
+                    kw["progress"] = job.progress_sink
                 if lease is not None:
                     import jax
 
@@ -847,6 +899,7 @@ class Scheduler:
                 tracer=self.tracer,
                 cancel=job.cancel.check,
                 grace_s=self.cancel_grace_s,
+                progress=job.progress_sink,
             )
             if (
                 dres is None

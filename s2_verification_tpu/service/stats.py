@@ -159,6 +159,7 @@ class ServiceStats:
             "partition_deltas": 0,
             "partitions_done": 0,
             "epoch_fences": 0,
+            "search_progress": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -428,6 +429,24 @@ class ServiceStats:
             "Per-device bytes in use (when the backend reports memory stats)",
             labelnames=("device",),
         )
+        # Live search progress (service/progress.JobProgress heartbeats):
+        # last-heartbeat values per engine family — a watch surface, not a
+        # per-job timeseries (job ids would be unbounded labels).
+        self._m_progress_ratio = r.gauge(
+            "verifyd_search_progress_ratio",
+            "Committed fraction of the search (last heartbeat), by engine",
+            labelnames=("engine",),
+        )
+        self._m_frontier_width = r.gauge(
+            "verifyd_search_frontier_width",
+            "Live frontier width of the search (last heartbeat), by engine",
+            labelnames=("engine",),
+        )
+        self._m_layer_rate = r.gauge(
+            "verifyd_search_layer_rate",
+            "EWMA search layers per second (last heartbeat), by engine",
+            labelnames=("engine",),
+        )
 
     # -- event stream -------------------------------------------------------
 
@@ -684,6 +703,28 @@ class ServiceStats:
             if op not in ("grant", "delta", "delta_reply", "done"):
                 op = "other"
             self._m_ds_fences.inc(op=op)
+        elif event == "search_progress":
+            self._counters["search_progress"] += 1
+            engine = str(fields.get("engine", "other"))
+            if engine not in (
+                "native",
+                "oracle",
+                "frontier",
+                "device",
+                "device-mesh",
+                "batch-native",
+                "batch-vmap",
+            ):
+                engine = "other"
+            self._m_progress_ratio.set(
+                float(fields.get("progress_ratio", 0.0)), engine=engine
+            )
+            self._m_frontier_width.set(
+                float(fields.get("frontier_width", 0)), engine=engine
+            )
+            self._m_layer_rate.set(
+                float(fields.get("layer_rate", 0.0)), engine=engine
+            )
         elif event == "job_error":
             self._counters["job_errors"] += 1
             self._active = max(0, self._active - 1)

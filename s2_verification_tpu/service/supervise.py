@@ -97,6 +97,7 @@ def supervised_device_check(
     tracer=None,
     cancel=None,
     grace_s: float = 5.0,
+    progress=None,
 ) -> CheckResult | None:
     """Run the device search for ``events`` under supervision.
 
@@ -124,6 +125,15 @@ def supervised_device_check(
     SIGTERMs the child, waits ``grace_s`` for a clean exit, SIGKILLs it
     otherwise, and returns ``None`` with no relaunch — the lease
     releases through the scheduler's normal ``finally``.
+
+    ``progress`` (a :class:`~..checker.progress.ProgressSink`) crosses
+    the process boundary over the same spool-file seam as the history and
+    result: the child overwrites ``jobN.progress.json`` atomically with
+    its latest heartbeat, and the parent reads it from inside the
+    driver's existing cancel poll (no extra thread) and re-offers it to
+    the job's sink — so a supervised search is as watchable as an inline
+    one, and the spooled file survives a SIGKILL for the flight
+    recorder's post-mortem.
     """
     from ..checker.resilient import default_probe_cmd, drive
     from ..obs.trace import NULL_TRACER
@@ -133,6 +143,7 @@ def supervised_device_check(
     hist_path = os.path.join(spool_dir, f"job{job_id}.jsonl")
     ckpt_path = os.path.join(spool_dir, f"job{job_id}.ckpt.npz")
     out_path = os.path.join(spool_dir, f"job{job_id}.result.json")
+    progress_path = os.path.join(spool_dir, f"job{job_id}.progress.json")
     with open(hist_path, "w", encoding="utf-8") as f:
         ev.write_history(events, f)
 
@@ -156,6 +167,9 @@ def supervised_device_check(
         # Distributed-trace propagation: the child runs its own Tracer
         # under this id and ships its span ring back in the result JSON.
         cmd.append("trace=" + trace_id)
+    if progress is not None:
+        cmd.append("progress=" + progress_path)
+        cancel = _progress_poll(cancel, progress, progress_path)
     try:
         outcome = drive(
             cmd,
@@ -176,11 +190,45 @@ def supervised_device_check(
     except (OSError, ValueError, KeyError):
         return None
     finally:
-        for p in (hist_path, ckpt_path, out_path):
+        for p in (hist_path, ckpt_path, out_path, progress_path):
             try:
                 os.remove(p)
             except OSError:
                 pass
+
+
+def _progress_poll(cancel, sink, path, min_interval_s: float = 0.5):
+    """Wrap the driver's cancel poll to also drain the child's spooled
+    heartbeat.  The driver already polls cancel every ~0.25s while it
+    waits on the child; reading one small JSON file at bounded cadence
+    rides that loop for free (no babysitter thread)."""
+    import time as _time
+
+    state = {"next": 0.0, "stamp": None}
+
+    def poll():
+        now = _time.monotonic()
+        if now >= state["next"]:
+            state["next"] = now + min_interval_s
+            try:
+                with open(path, encoding="utf-8") as f:
+                    rec = json.load(f)
+                stamp = (rec.get("ops_committed"), rec.get("layer"))
+                if stamp != state["stamp"]:
+                    state["stamp"] = stamp
+                    sink.update(
+                        ops_committed=int(rec.get("ops_committed", 0)),
+                        total_ops=int(rec.get("total_ops", 0)),
+                        frontier_width=int(rec.get("frontier_width", 0)),
+                        states_expanded=int(rec.get("states_expanded", 0)),
+                        layer=rec.get("layer"),
+                        engine=str(rec.get("engine", "device")),
+                    )
+            except (OSError, ValueError, TypeError):
+                pass
+        return cancel() if cancel is not None else None
+
+    return poll
 
 
 def _child_main(argv: list[str]) -> int:
@@ -191,6 +239,7 @@ def _child_main(argv: list[str]) -> int:
     devices: list[int] | None = None
     profile = False
     trace_id = ""
+    progress_path = ""
     for extra in argv[3:]:
         if extra.startswith("devices="):
             devices = [int(s) for s in extra[len("devices=") :].split(",") if s]
@@ -198,6 +247,8 @@ def _child_main(argv: list[str]) -> int:
             profile = extra[len("profile=") :] == "1"
         elif extra.startswith("trace="):
             trace_id = extra[len("trace=") :]
+        elif extra.startswith("progress="):
+            progress_path = extra[len("progress=") :]
         else:
             device_rows = int(extra)
     if not trace_id:
@@ -230,6 +281,22 @@ def _child_main(argv: list[str]) -> int:
     kw: dict = {} if device_rows is None else {"device_rows_cap": device_rows}
     if profile:
         kw["profile"] = True
+    if progress_path:
+        # The latest heartbeat overwrites the spool file atomically: the
+        # parent samples it from its cancel poll, and whatever survives a
+        # SIGKILL tells the post-mortem how far the search got.
+        from ..checker.progress import ProgressSink
+
+        def _spool(rec, _path=progress_path):
+            tmp = f"{_path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(rec, f)
+                os.replace(tmp, _path)
+            except OSError:
+                pass
+
+        kw["progress"] = ProgressSink(_spool)
     if devices is not None:
         import jax
 

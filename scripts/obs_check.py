@@ -63,7 +63,14 @@ What it checks (the `make obs` gate):
     ``verifyd_jobs_cancelled_total``, ``verifyd_admission_shed_total``,
     ``verifyd_quarantine_size``, and ``verifyd_writer_degraded`` with
     every label value drawn from its bounded set — reasons and writer
-    names are enums, never payload-derived.
+    names are enums, never payload-derived;
+17. search progress: a deliberately slow job watched live over the
+    ``watch`` op must show monotone non-decreasing ``ops_committed``
+    that actually advances, the three progress families
+    (``verifyd_search_progress_ratio``/``_frontier_width``/
+    ``_layer_rate``) must appear with engine labels drawn from the
+    bounded engine set, and a ``search_progress`` record must land in
+    the flight ring, readable cold after shutdown.
 
 Exit 0 on success, 1 with a diagnostic on the first violated property.
 Pure stdlib + the package; runs on CPU in under a minute.
@@ -155,6 +162,19 @@ REQUIRED_OVERLOAD_FAMILIES = (
 CANCEL_REASONS = {"deadline", "client_gone", "shutdown", "other"}
 SHED_REASONS = {"rss", "fds", "deadline", "other"}
 DEGRADED_WRITERS = {"journal", "cache", "archive", "flight"}
+
+#: search-progress families (ISSUE 18) and the bounded engine set the
+#: stats layer folds heartbeat engine names into — cardinality is an
+#: enum by construction, and the check fails if a new value leaks in
+REQUIRED_PROGRESS_FAMILIES = (
+    "verifyd_search_progress_ratio",
+    "verifyd_search_frontier_width",
+    "verifyd_search_layer_rate",
+)
+PROGRESS_ENGINES = {
+    "native", "oracle", "frontier", "device", "device-mesh",
+    "batch-native", "batch-vmap", "other",
+}
 
 #: one OpenMetrics exemplar suffix: `` # {trace_id="<32 hex>"} <v> <ts>``
 EXEMPLAR_RE = r'# \{trace_id="([0-9a-f]{32})"\} [0-9.eE+-]+ [0-9.]+$'
@@ -581,8 +601,13 @@ def main() -> int:
                         f"shard label cardinality {len(shard_labels)} exceeds "
                         f"the {MESH_N}-device pool: {sorted(shard_labels)}"
                     )
+                # PR 12 folds sized backend values ("device-mesh[4]") to
+                # the engine family before they become labels, so the
+                # series is the folded name.
                 wall_series = _histogram_series(body, "verifyd_wall_seconds")
-                if not any("device-mesh[" in labels for labels in wall_series):
+                if not any(
+                    'backend="device-mesh"' in labels for labels in wall_series
+                ):
                     return _fail(
                         f"verifyd_wall_seconds has no device-mesh backend "
                         f"series: {sorted(wall_series)}"
@@ -622,6 +647,152 @@ def main() -> int:
                         f"stats op introspection shows no compiles after a "
                         f"mesh job: {mesh_jit}"
                     )
+    finally:
+        sched_mod._cpu_check = real_cpu_check
+
+    # -- progress phase: watch a slow job live; families + flight ring ------
+    import threading as _pthreading
+    import time as _ptime
+
+    def _slow_search(hist, budget, profile=False, progress=None):
+        # A deliberately slow engine that feeds the production sink the
+        # way check_frontier does: one update per layer, the sink's
+        # time gate deciding what leaves.  ~1.2s wall, so a 0.1s
+        # heartbeat interval yields a stream the watcher can sample.
+        total = 60
+        for i in range(1, total + 1):
+            if progress is not None:
+                progress.update(
+                    ops_committed=i,
+                    total_ops=total,
+                    frontier_width=4 + (i % 7),
+                    states_expanded=i * 10,
+                    layer=i,
+                    engine="frontier",
+                    final=(i == total),
+                )
+            _ptime.sleep(0.02)
+        return CheckResult(CheckOutcome.OK), "frontier"
+
+    sched_mod._cpu_check = _slow_search
+    try:
+        with tempfile.TemporaryDirectory(prefix="obs-check-progress-") as d:
+            sock = os.path.join(d, "verifyd.sock")
+            state = os.path.join(d, "state")
+            cfg = VerifydConfig(
+                socket_path=sock,
+                out_dir=os.path.join(d, "viz"),
+                no_viz=True,
+                stats_log=None,
+                device="off",
+                metrics_port=0,
+                state_dir=state,
+                progress_interval_s=0.1,
+            )
+            with Verifyd(cfg) as daemon:
+                client = VerifydClient(sock)
+                submit_reply: dict = {}
+
+                def _submit():
+                    submit_reply.update(
+                        VerifydClient(sock).submit(
+                            texts[0], client="obs-progress", timeout=120
+                        )
+                    )
+
+                t = _pthreading.Thread(target=_submit, daemon=True)
+                t.start()
+                # Live watch: sample ops_committed until the job leaves
+                # the active table; the stream must be monotone AND move.
+                ops_seen: list[int] = []
+                deadline = _ptime.monotonic() + 30
+                watcher = VerifydClient(sock)
+                while t.is_alive() and _ptime.monotonic() < deadline:
+                    rows = watcher.watch().get("progress") or []
+                    for row in rows:
+                        ops_seen.append(int(row["ops_committed"]))
+                        if row.get("engine") not in PROGRESS_ENGINES:
+                            return _fail(
+                                f"progress: watch row engine "
+                                f"{row.get('engine')!r} outside the bounded "
+                                f"set"
+                            )
+                    _ptime.sleep(0.05)
+                t.join(timeout=60)
+                if submit_reply.get("verdict") != 0:
+                    return _fail(
+                        f"progress: slow job failed: {submit_reply}"
+                    )
+                if len(ops_seen) < 2:
+                    return _fail(
+                        f"progress: watch sampled only {len(ops_seen)} "
+                        f"ops_committed value(s) across a ~1.2s job"
+                    )
+                if ops_seen != sorted(ops_seen):
+                    return _fail(
+                        f"progress: watch ops_committed not monotone: "
+                        f"{ops_seen}"
+                    )
+                if ops_seen[-1] <= ops_seen[0]:
+                    return _fail(
+                        f"progress: watch ops_committed never advanced: "
+                        f"{ops_seen}"
+                    )
+                progress_samples = len(ops_seen)
+                body = (
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{daemon.metrics_port}/metrics",
+                        timeout=5,
+                    )
+                    .read()
+                    .decode("utf-8")
+                )
+                kinds = _parse_families(body)
+                for fam in REQUIRED_PROGRESS_FAMILIES:
+                    if fam not in kinds:
+                        return _fail(
+                            f"progress: family {fam} missing from /metrics "
+                            f"(have: "
+                            f"{sorted(k for k in kinds if 'search' in k)})"
+                        )
+                engine_labels = {
+                    line.split('engine="', 1)[1].split('"', 1)[0]
+                    for line in body.splitlines()
+                    if line.startswith("verifyd_search_")
+                    and 'engine="' in line
+                }
+                if not engine_labels:
+                    return _fail(
+                        "progress: progress families carry no engine label"
+                    )
+                if not engine_labels <= PROGRESS_ENGINES:
+                    return _fail(
+                        f"progress: engine label cardinality leaked past "
+                        f"the bounded set: "
+                        f"{sorted(engine_labels - PROGRESS_ENGINES)}"
+                    )
+            # Cold read: the ring must hold search_progress records a
+            # doctor run on this state dir would fold into its
+            # post-mortem.
+            from s2_verification_tpu.obs.flight import read_flight
+
+            flight_beats = [
+                rec
+                for rec in read_flight(state)
+                if (rec.get("ev") or rec.get("event")) == "search_progress"
+            ]
+            if not flight_beats:
+                return _fail(
+                    "progress: no search_progress record in the flight ring"
+                )
+            if not all(
+                "ops_committed" in rec and "total_ops" in rec
+                for rec in flight_beats
+            ):
+                return _fail(
+                    f"progress: flight records lack progress fields: "
+                    f"{flight_beats[:2]}"
+                )
     finally:
         sched_mod._cpu_check = real_cpu_check
 
@@ -1415,7 +1586,10 @@ def main() -> int:
         f"{len(fleet_pids)} pids, {len(REQUIRED_OVERLOAD_FAMILIES)} "
         f"overload families with bounded labels (cancel "
         f"{sorted(cancel_reasons)}, shed {sorted(shed_reasons)}, degraded "
-        f"{sorted(degraded_writers)})"
+        f"{sorted(degraded_writers)}), watch sampled {progress_samples} "
+        f"monotone ops values with {len(flight_beats)} search_progress "
+        f"heartbeat(s) in the flight ring over engines "
+        f"{sorted(engine_labels)}"
     )
     return 0
 

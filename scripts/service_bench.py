@@ -414,6 +414,13 @@ def main() -> int:
                     "to CPU) and report the mesh serving row "
                     "service_mesh_jobs_per_sec next to the published "
                     "service_jobs_per_sec baseline")
+    ap.add_argument("--progress-interval", type=float, default=0.5,
+                    metavar="S",
+                    help="in-process daemon only: search-progress heartbeat "
+                    "cadence (0 disables heartbeats — the control run for "
+                    "the progress overhead gate; default 0.5s, the daemon "
+                    "default, so the standard bench row IS the "
+                    "heartbeat-enabled number)")
     ap.add_argument("--max-rss-frac", type=float, default=0.0,
                     help="in-process daemon only: arm the pressure-aware "
                     "AdmissionController at this RSS watermark (0 "
@@ -588,6 +595,7 @@ def main() -> int:
                 fast_admission=args.fast_admission,
                 batching=args.batching,
                 batch_engine=args.batch_engine,
+                progress_interval_s=args.progress_interval,
             )
         )
         daemon_ctx.__enter__()
@@ -730,6 +738,21 @@ def main() -> int:
             "p99_ms": round(p99 * 1e3, 2),
             "shapes": shapes,
         }
+        if not args.socket:
+            # Progress-heartbeat overhead gate: the in-process daemon runs
+            # with heartbeats on by default, so the standard bench row is
+            # the heartbeat-enabled number and must hold >= 0.97x the
+            # published baseline (the same bar the introspection and
+            # admission-controller riders cleared).  --progress-interval 0
+            # produces the heartbeat-free control row for A/B on one host.
+            progress_on = args.progress_interval > 0
+            line["progress_heartbeats"] = progress_on
+            line["progress_interval_s"] = args.progress_interval
+            if progress_on and baseline and metric == "service_jobs_per_sec":
+                line["progress_overhead_floor"] = 0.97
+                line["progress_overhead_ok"] = (
+                    line["vs_baseline"] >= 0.97
+                )
         if args.batching:
             line["batching"] = True
             line["batch_engine"] = args.batch_engine

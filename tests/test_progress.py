@@ -1,0 +1,586 @@
+"""Live search progress telemetry (ISSUE 18): sink cadence, EWMA/ETA
+math, the supervised-child heartbeat seam, the ``watch`` op through
+daemon and router, the distsearch stall clock, and per-lane batched
+attribution.
+
+Everything runs under the session-wide ``JAX_PLATFORMS=cpu`` pin.  The
+governing invariants: heartbeats are time-gated (a trivial job emits
+zero), folds are monotone in ``ops_committed``, and ``watch`` answers
+are either definite rows or definite errors — never a hang.
+"""
+
+import io
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from s2_verification_tpu.checker.batched import (
+    BatchLane,
+    check_batch_native,
+    check_batch_vmap,
+)
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.native import native_available
+from s2_verification_tpu.checker.oracle import CheckOutcome, CheckResult
+from s2_verification_tpu.checker.progress import ProgressSink
+from s2_verification_tpu.models.encode import encode_batch
+from s2_verification_tpu.service import scheduler as sched_mod
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.distsearch import (
+    Coordinator,
+    DistSearchConfig,
+    _Attempt,
+)
+from s2_verification_tpu.service.progress import JobProgress
+from s2_verification_tpu.service.protocol import ERR_DECODE, ERR_UNKNOWN_JOB
+from s2_verification_tpu.service.router import (
+    BackendSpec,
+    RouterConfig,
+    VerifydRouter,
+)
+from s2_verification_tpu.service.supervise import _progress_poll
+from s2_verification_tpu.utils import events as ev
+
+from helpers import H, fold
+
+needs_native = pytest.mark.skipif(
+    not native_available(), reason="native C engine not built"
+)
+
+
+class Clock:
+    """Injectable monotonic clock."""
+
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _history(i: int = 0) -> H:
+    h = H()
+    h.append_ok(1, [100 + i], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([100 + i]))
+    h.append_ok(2, [200 + i, 300 + i], tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([100 + i, 200 + i, 300 + i]))
+    return h
+
+
+def _text(i: int = 0) -> str:
+    buf = io.StringIO()
+    ev.write_history(_history(i).events, buf)
+    return buf.getvalue()
+
+
+def _daemon_cfg(tmp_path, **overrides) -> VerifydConfig:
+    kw = dict(
+        socket_path=str(tmp_path / "verifyd.sock"),
+        workers=1,
+        device="off",
+        time_budget_s=10.0,
+        no_viz=True,
+        out_dir=str(tmp_path / "viz"),
+        stats_log=None,
+    )
+    kw.update(overrides)
+    return VerifydConfig(**kw)
+
+
+def _slow_engine(total: int = 30, step_s: float = 0.02):
+    """A stand-in CPU engine that reports per-layer progress the way
+    check_frontier does, slow enough for a watcher to sample it live."""
+
+    def run(hist, budget, profile=False, progress=None):
+        for i in range(1, total + 1):
+            if progress is not None:
+                progress.update(
+                    ops_committed=i,
+                    total_ops=total,
+                    frontier_width=3 + (i % 5),
+                    states_expanded=i * 7,
+                    layer=i,
+                    engine="frontier",
+                    final=(i == total),
+                )
+            time.sleep(step_s)
+        return CheckResult(CheckOutcome.OK), "frontier"
+
+    return run
+
+
+# -- sink cadence bounding ----------------------------------------------------
+
+
+def test_sink_first_update_is_baseline_only():
+    clock, out = Clock(), []
+    sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    assert sink.update(ops_committed=0, total_ops=100) is False
+    assert out == [] and sink.emitted == 0
+
+
+def test_sink_cadence_is_time_gated_not_call_gated():
+    clock, out = Clock(), []
+    sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    # A hot layer loop: 100 offers over one second of wall clock must
+    # leave at most two heartbeats (one per 0.5s interval).
+    for i in range(100):
+        sink.update(ops_committed=i, total_ops=100, layer=i)
+        clock.tick(0.01)
+    assert 1 <= len(out) <= 2
+    assert all(rec["engine"] == "other" for rec in out)
+
+
+def test_trivial_job_emits_zero_heartbeats():
+    clock, out = Clock(), []
+    sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    sink.update(ops_committed=0, total_ops=4)
+    clock.tick(0.1)
+    sink.update(ops_committed=2, total_ops=4)
+    clock.tick(0.1)
+    # The final offer lands inside the very first interval: silence.
+    assert sink.update(ops_committed=4, total_ops=4, final=True) is False
+    assert out == []
+
+
+def test_sink_final_emits_once_past_one_interval():
+    clock, out = Clock(), []
+    sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    sink.update(ops_committed=0, total_ops=4)
+    clock.tick(0.6)
+    assert sink.update(ops_committed=4, total_ops=4, final=True) is True
+    assert len(out) == 1 and out[0]["final"] is True
+
+
+def test_sink_layer_rate_and_lane_attribution():
+    clock, out = Clock(), []
+    sink = ProgressSink(
+        out.append, min_interval_s=0.5, time_fn=clock, engine="device", lane=3
+    )
+    sink.update(ops_committed=0, total_ops=10, layer=0)
+    clock.tick(1.0)
+    sink.update(ops_committed=5, total_ops=10, layer=5)
+    assert len(out) == 1
+    assert out[0]["layer_rate"] == pytest.approx(5.0)
+    assert out[0]["engine"] == "device" and out[0]["lane"] == 3
+
+
+# -- EWMA / ETA math with an injected clock -----------------------------------
+
+
+def test_jobprogress_ewma_and_eta():
+    clock = Clock()
+    table = JobProgress(interval_s=0.5, ewma_alpha=0.3, time_fn=clock)
+    sink = table.sink_for(7, fingerprint="fp7", shape="2x4x8")
+    # Registered at job start: watch sees the row before any heartbeat.
+    rows = table.rows()
+    assert [r["job"] for r in rows] == [7]
+    assert rows[0]["ops_committed"] == 0 and rows[0]["heartbeats"] == 0
+
+    sink.update(ops_committed=0, total_ops=100)  # baseline
+    clock.tick(1.0)
+    sink.update(ops_committed=10, total_ops=100)
+    row = table.get(7)
+    assert row["ops_rate"] == pytest.approx(10.0)
+    assert row["eta_s"] == pytest.approx(9.0)
+    assert row["progress_ratio"] == pytest.approx(0.1)
+
+    clock.tick(1.0)
+    sink.update(ops_committed=20, total_ops=100)
+    row = table.get(7)
+    assert row["ops_rate"] == pytest.approx(10.0)
+    assert row["eta_s"] == pytest.approx(8.0)
+
+    # A stalled interval drags the EWMA down and pushes the ETA out.
+    clock.tick(1.0)
+    sink.update(ops_committed=20, total_ops=100)
+    row = table.get(7)
+    assert row["ops_rate"] == pytest.approx(7.0)
+    assert row["eta_s"] == pytest.approx(80 / 7.0, rel=1e-3)
+
+    # Monotone fold: a regressing sample can never move ops backwards.
+    clock.tick(1.0)
+    sink.update(ops_committed=5, total_ops=100)
+    assert table.get(7)["ops_committed"] == 20
+
+    table.finish(7, outcome="ok")
+    assert table.rows() == []
+    done = table.get(7)
+    assert done["done"] is True and done["outcome"] == "ok"
+
+
+def test_jobprogress_find_by_partition_prefix():
+    table = JobProgress(interval_s=0.5, time_fn=Clock())
+    table.sink_for(1, fingerprint="ppart:abcd1234abcd1234/p0")
+    table.sink_for(2, fingerprint="ppart:abcd1234abcd1234/p1")
+    table.sink_for(3, fingerprint="other")
+    hits = table.find("ppart:abcd1234abcd1234/", prefix=True)
+    assert [r["job"] for r in hits] == [1, 2]
+    assert table.find("other") and not table.find("nope")
+
+
+# -- supervised-child heartbeat round-trip ------------------------------------
+
+
+def test_supervised_spool_roundtrip(tmp_path):
+    path = str(tmp_path / "job1.progress.json")
+
+    def spool(rec):  # the child side: atomic overwrite of the latest beat
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(rec, f)
+        os.replace(tmp, path)
+
+    clock, out = Clock(), []
+    parent_sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    cancelled = []
+    poll = _progress_poll(
+        lambda: cancelled and cancelled[0] or None,
+        parent_sink,
+        path,
+        min_interval_s=0.0,
+    )
+
+    spool({"ops_committed": 5, "total_ops": 10, "layer": 2, "engine": "device"})
+    assert poll() is None  # baseline fold, no heartbeat yet
+    clock.tick(1.0)
+    spool({"ops_committed": 7, "total_ops": 10, "layer": 4, "engine": "device"})
+    poll()
+    assert len(out) == 1
+    assert out[0]["ops_committed"] == 7 and out[0]["engine"] == "device"
+    # Same stamp: deduped, the sink is not even offered.
+    clock.tick(1.0)
+    poll()
+    assert len(out) == 1
+    # The wrapper still carries the driver's cancel signal.
+    cancelled.append("deadline")
+    assert poll() == "deadline"
+
+
+def test_supervised_spool_tolerates_garbage(tmp_path):
+    path = str(tmp_path / "job2.progress.json")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("not json{")
+    sink = ProgressSink(lambda rec: None, time_fn=Clock())
+    poll = _progress_poll(lambda: None, sink, path, min_interval_s=0.0)
+    assert poll() is None  # malformed spool is ignored, never a crash
+
+
+# -- watch op through the daemon ----------------------------------------------
+
+
+def test_watch_live_job_monotone_then_done(tmp_path, monkeypatch):
+    monkeypatch.setattr(sched_mod, "_cpu_check", _slow_engine())
+    cfg = _daemon_cfg(tmp_path, progress_interval_s=0.05)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path)
+        reply: dict = {}
+        t = threading.Thread(
+            target=lambda: reply.update(
+                VerifydClient(cfg.socket_path).submit(_text(), timeout=60)
+            ),
+            daemon=True,
+        )
+        t.start()
+        seen: list[dict] = []
+        deadline = time.monotonic() + 30
+        while t.is_alive() and time.monotonic() < deadline:
+            for row in client.watch().get("progress") or []:
+                seen.append(row)
+            time.sleep(0.02)
+        t.join(timeout=30)
+        assert reply.get("verdict") == 0
+        assert len(seen) >= 2
+        ops = [r["ops_committed"] for r in seen]
+        assert ops == sorted(ops) and ops[-1] > ops[0]
+        assert all(r["engine"] in ("other", "frontier") for r in seen)
+        # The finished job still answers by id, from the done ring.
+        done = client.watch(job=seen[-1]["job"])["progress"][0]
+        assert done["done"] is True
+
+
+def test_watch_unknown_job_is_definite(tmp_path):
+    cfg = _daemon_cfg(tmp_path)
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path)
+        with pytest.raises(VerifydError) as exc:
+            client.watch(job=999999)
+        assert exc.value.cls == ERR_UNKNOWN_JOB
+        with pytest.raises(VerifydError) as exc:
+            client.watch(fingerprint="no-such-fp")
+        assert exc.value.cls == ERR_UNKNOWN_JOB
+        # No selector: an empty board, not an error.
+        assert client.watch()["progress"] == []
+
+
+def test_watch_refused_when_heartbeats_disabled(tmp_path):
+    cfg = _daemon_cfg(tmp_path, progress_interval_s=0.0)
+    with Verifyd(cfg):
+        with pytest.raises(VerifydError) as exc:
+            VerifydClient(cfg.socket_path).watch()
+        assert exc.value.cls == ERR_DECODE
+
+
+# -- watch op through the router ----------------------------------------------
+
+
+def _router_cfg(tmp_path, names, **overrides) -> RouterConfig:
+    kw = dict(
+        listen=str(tmp_path / "router.sock"),
+        backends=tuple(
+            BackendSpec(n, str(tmp_path / f"{n}.sock")) for n in names
+        ),
+        probe_interval_s=30.0,
+    )
+    kw.update(overrides)
+    return RouterConfig(**kw)
+
+
+def _backend_cfg(tmp_path, name, **overrides) -> VerifydConfig:
+    return _daemon_cfg(
+        tmp_path,
+        socket_path=str(tmp_path / f"{name}.sock"),
+        out_dir=str(tmp_path / f"viz-{name}"),
+        **overrides,
+    )
+
+
+def test_watch_through_router_tags_nodes(tmp_path, monkeypatch):
+    monkeypatch.setattr(sched_mod, "_cpu_check", _slow_engine())
+    with Verifyd(_backend_cfg(tmp_path, "a", progress_interval_s=0.05)), \
+            VerifydRouter(_router_cfg(tmp_path, ("a",))) as router:
+        client = VerifydClient(router.cfg.listen)
+        reply: dict = {}
+        t = threading.Thread(
+            target=lambda: reply.update(
+                VerifydClient(router.cfg.listen).submit(_text(), timeout=60)
+            ),
+            daemon=True,
+        )
+        t.start()
+        rows: list[dict] = []
+        deadline = time.monotonic() + 30
+        while t.is_alive() and time.monotonic() < deadline:
+            rows.extend(client.watch().get("progress") or [])
+            time.sleep(0.02)
+        t.join(timeout=30)
+        assert reply.get("verdict") == 0
+        assert rows and all(r["node"] == "a" for r in rows)
+        ops = [r["ops_committed"] for r in rows]
+        assert ops == sorted(ops) and ops[-1] > ops[0]
+
+
+def test_watch_through_router_unknown_job_is_definite(tmp_path):
+    with Verifyd(_backend_cfg(tmp_path, "a")), VerifydRouter(
+        _router_cfg(tmp_path, ("a",))
+    ) as router:
+        client = VerifydClient(router.cfg.listen)
+        with pytest.raises(VerifydError) as exc:
+            client.watch(job=424242)
+        assert exc.value.cls == ERR_UNKNOWN_JOB
+        assert client.watch()["progress"] == []
+
+
+# -- distsearch: progress-rate stall clock vs wall clock ----------------------
+
+
+class _WatchStub:
+    """A backend client stub for the coordinator's progress poll."""
+
+    def __init__(self):
+        self.row = None  # None → answer UnknownJob (owner never reports)
+
+    def watch(self, fingerprint=None, timeout=None):
+        if self.row is None:
+            raise VerifydError(ERR_UNKNOWN_JOB, "no such job")
+        return {"progress": [dict(self.row)]}
+
+
+def _coordinator(stub) -> Coordinator:
+    return Coordinator(
+        search="c" * 64,
+        nodes=lambda: [("a", stub)],
+        config=DistSearchConfig(progress_poll_s=0.5),
+    )
+
+
+def _poll_until_harvest(coord, attempt, now: float) -> float:
+    """Launch one poll and harvest it; returns the harvest timestamp."""
+    coord._poll_progress(attempt, now)
+    assert attempt.poll_future is not None
+    while not attempt.poll_future.done():
+        time.sleep(0.005)
+    now += 0.01
+    coord._poll_progress(attempt, now)
+    return now
+
+
+def test_stall_clock_advances_only_with_progress():
+    stub = _WatchStub()
+    coord = _coordinator(stub)
+    try:
+        a = _Attempt(part="p0", epoch=1, node="a", future=None)
+        granted_at = a.last_advance
+
+        # Owner reports ops=5: the stall clock advances past grant time.
+        stub.row = {"ops_committed": 5, "total_ops": 40, "states_expanded": 9}
+        t1 = _poll_until_harvest(coord, a, now=granted_at + 10.0)
+        assert a.ops == 5 and a.last_advance == t1 > granted_at
+        assert coord.progress["p0"]["ops_committed"] == 5
+        assert coord.progress["p0"]["node"] == "a"
+
+        # Same numbers again: the search stopped moving — the clock does
+        # not advance, so the straggler budget now runs against it.
+        t2 = _poll_until_harvest(coord, a, now=t1 + 1.0)
+        assert a.last_advance == t1 < t2
+        assert coord.progress["p0"]["stalled_s"] > 0
+
+        # It moves again: fresh clock.
+        stub.row = {"ops_committed": 11, "total_ops": 40, "states_expanded": 20}
+        t3 = _poll_until_harvest(coord, a, now=t2 + 1.0)
+        assert a.ops == 11 and a.last_advance == t3
+
+        # a saw progress, so an eventual steal is a "stall-steal".
+        assert a.ops >= 0
+        snap = coord.progress_snapshot()
+        assert snap["partitions"]["p0"]["ops_committed"] == 11
+    finally:
+        coord._pool.shutdown(wait=False)
+
+
+def test_silent_owner_degrades_to_wall_clock_rule():
+    stub = _WatchStub()  # row stays None: every watch answers UnknownJob
+    coord = _coordinator(stub)
+    try:
+        a = _Attempt(part="p0", epoch=1, node="a", future=None)
+        granted_at = a.last_advance
+        t1 = _poll_until_harvest(coord, a, now=granted_at + 10.0)
+        _poll_until_harvest(coord, a, now=t1 + 1.0)
+        # No heartbeat ever seen: the stall clock never moved off grant
+        # time (legacy wall-clock stealing) and the steal reason stays
+        # the legacy "steal", not "stall-steal".
+        assert a.last_advance == granted_at
+        assert a.ops == -1 and a.expanded == -1
+        assert "p0" not in coord.progress
+    finally:
+        coord._pool.shutdown(wait=False)
+
+
+def test_stall_steal_reason_is_counted():
+    counts: dict[str, int] = {}
+    stub = _WatchStub()
+
+    class _Seg:
+        key = "seg0"
+
+    class _GrantStub:
+        def grant(self, **kw):
+            return {"ok": True}
+
+        def delta(self, *a, **kw):
+            return {"verdict": 2}
+
+    coord = Coordinator(
+        search="c" * 64,
+        nodes=lambda: [("a", stub)],
+        config=DistSearchConfig(progress_poll_s=0.5),
+        counter=lambda key, n=1: counts.__setitem__(
+            key, counts.get(key, 0) + n
+        ),
+    )
+    try:
+        coord._grant_and_ship(
+            _Seg(), "", "p0", (), "a", _GrantStub(), "stall-steal"
+        )
+        assert coord.stall_steals == 1 and coord.steals == 1
+        assert counts.get("stall_stolen") == 1 and counts.get("stolen") == 1
+        coord._grant_and_ship(
+            _Seg(), "", "p1", (), "a", _GrantStub(), "steal"
+        )
+        assert coord.stall_steals == 1 and coord.steals == 2
+        assert counts.get("stall_stolen") == 1 and counts.get("stolen") == 2
+    finally:
+        coord._pool.shutdown(wait=False)
+
+
+# -- batched lanes: per-lane attribution --------------------------------------
+
+
+def _busy_history(i: int) -> H:
+    """Three overlapping indefinite appends: their order is ambiguous and
+    each forks committed/uncommitted, so the lane carries real search
+    work (a serial history elides to a trivially-OK lane that —
+    correctly — never heartbeats)."""
+    from s2_verification_tpu.utils.events import AppendIndefiniteFailure
+
+    h = H()
+    calls = [h.call_append(k + 1, [100 * (k + 1) + i]) for k in range(3)]
+    for k, op in enumerate(calls):
+        h.finish(k + 1, op, AppendIndefiniteFailure())
+    h.read_ok(4, tail=0, stream_hash=fold([]))
+    return h
+
+
+def _lanes(n: int):
+    hists = [
+        prepare(_busy_history(i).events, elide_trivial=True) for i in range(n)
+    ]
+    return [
+        BatchLane(h, enc) for h, enc in zip(hists, encode_batch(list(hists)))
+    ]
+
+
+def test_batch_vmap_per_lane_attribution():
+    lanes = _lanes(3)
+    outs: list[list[dict]] = [[] for _ in lanes]
+    sinks = [
+        ProgressSink(outs[i].append, min_interval_s=0.0, engine="batch-vmap")
+        for i in range(len(lanes))
+    ]
+    verdicts = check_batch_vmap(lanes, progress=sinks)
+    for i, (lane, v) in enumerate(zip(lanes, verdicts)):
+        if v.result is None:
+            continue  # escalated lanes report nothing final
+        assert outs[i], f"lane {i} never heartbeat"
+        last = outs[i][-1]
+        # Each lane's heartbeats carry its OWN op counts — attribution
+        # never bleeds across launch-mates.
+        assert last["total_ops"] == len(lane.history.ops)
+        assert last["engine"] == "batch-vmap"
+        if v.result.outcome == CheckOutcome.OK:
+            assert last["ops_committed"] == len(lane.history.ops)
+
+
+@needs_native
+def test_batch_native_per_lane_attribution():
+    lanes = _lanes(3)
+    outs: list[list[dict]] = [[] for _ in lanes]
+    sinks = [
+        ProgressSink(outs[i].append, min_interval_s=0.0) for i in range(3)
+    ]
+    verdicts = check_batch_native(lanes, progress=sinks)
+    assert all(v.result is not None for v in verdicts)
+    for i, lane in enumerate(lanes):
+        assert outs[i]
+        assert outs[i][-1]["total_ops"] == len(lane.history.ops)
+        assert outs[i][-1]["engine"] == "batch-native"
+
+
+def test_batch_skipped_lane_stays_silent():
+    lanes = _lanes(2)
+    outs: list[list[dict]] = [[] for _ in lanes]
+    sinks = [
+        ProgressSink(outs[i].append, min_interval_s=0.0) for i in range(2)
+    ]
+    verdicts = check_batch_vmap(
+        lanes, skip=lambda i: "cancelled" if i == 0 else None, progress=sinks
+    )
+    assert verdicts[0].skipped == "cancelled"
+    assert outs[0] == []  # a skipped lane must not heartbeat
