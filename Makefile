@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet distsearch overload soak batch prefix perfgate lint clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet distsearch overload soak batch prefix prune perfgate lint clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: lint obs mesh fleet distsearch overload soak batch prefix
+chaos-full: lint obs mesh fleet distsearch overload soak batch prefix prune
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -103,6 +103,15 @@ batch: native
 # identical verdict; campaign parity against a prefix-less daemon.
 prefix:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/prefix_check.py
+
+# Search-pruning gate (scripts/prune_check.py): verdict parity of the
+# pruned + speculative engines (host frontier, native DFS, device
+# search) against the un-pruned referee across the full builtin
+# campaign matrix and all four violation classes, plus a >=1.3x
+# wall-time gate on the adversarial k=10 device bench config with
+# nonzero prune/speculation counters.
+prune: native
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/prune_check.py
 
 # Fleet gate (scripts/fleet_check.py): two subprocess backends behind
 # the router — SIGKILL mid-load loses zero accepted jobs, verdict parity

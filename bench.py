@@ -47,13 +47,25 @@ Child stderr is recorded and forwarded with the benign XLA:CPU
 ``cpu_aot_loader`` machine-feature warning wall filtered out, so the
 recorded bench tail stays readable.
 
+``--prune`` / ``--speculate-depth K`` additionally measure the search
+accelerator (checker/prune.py + the speculative multi-layer dive): the
+headline history and the adversarial instance are re-timed with the
+knobs on, emitting ``ops_verified_per_sec_chip_pruned`` and
+``adversarial_k*_device_wall_s_pruned`` stderr lines whose
+``vs_baseline`` is the same-run un-pruned wall over the pruned wall —
+the measured accelerator speedup — plus the nonzero prune/speculation
+counters that prove the fast path actually fired.  The stdout contract
+line stays the un-pruned measurement (cross-round comparability).
+
 Env knobs (all optional): S2VTPU_BENCH_CLIENTS, S2VTPU_BENCH_OPS,
 S2VTPU_BENCH_SEED, S2VTPU_BENCH_ORACLE_BUDGET_S, S2VTPU_BENCH_ADV_K,
 S2VTPU_BENCH_ADV_BATCH, S2VTPU_BENCH_ADV_NATIVE_BUDGET_S,
 S2VTPU_BENCH_SKIP_ADV, S2VTPU_BENCH_NO_FALLBACK,
 S2VTPU_BENCH_TPU_TIMEOUT_S (bound on the isolated measurement child,
 default 2700), S2VTPU_BENCH_NO_ISOLATE=1 (run the measurement in-process
-instead of the crash/hang-bounded child).
+instead of the crash/hang-bounded child), S2VTPU_BENCH_PRUNE=1 /
+S2VTPU_BENCH_SPEC_DEPTH=K (env forms of --prune / --speculate-depth,
+inherited by the bounded measurement children).
 """
 
 from __future__ import annotations
@@ -526,12 +538,89 @@ def north_star() -> int:
         flush=True,
     )
 
+    if _prune_enabled():
+        try:
+            t_ps: list[float] = []
+            pres = check_device_auto(
+                hist, prune=True, speculate_depth=_spec_depth(),
+                collect_stats=True, witness=False,
+            )
+            assert pres.outcome == CheckOutcome.OK
+            for _ in range(reps):
+                t0 = time.monotonic()
+                pres = check_device_auto(
+                    hist, prune=True, speculate_depth=_spec_depth(),
+                    collect_stats=True, witness=False,
+                )
+                t_ps.append(time.monotonic() - t0)
+                assert pres.outcome == CheckOutcome.OK
+            pruned_s = statistics.median(t_ps)
+            print(
+                f"# pruned device: steady median-of-{reps} {pruned_s:.2f}s "
+                f"({_prune_note(pres.stats)})",
+                file=sys.stderr,
+            )
+            print(
+                json.dumps(
+                    {
+                        "metric": "ops_verified_per_sec_chip_pruned",
+                        "value": round(n_ops / pruned_s, 2),
+                        "unit": "ops/s",
+                        # Same-run accelerator speedup, not a cross-round
+                        # target ratio.
+                        "vs_baseline": round(dev_s / pruned_s, 3),
+                        "backend": backend,
+                        "host_cpus": _host_cpus(),
+                        **_prune_counters(pres.stats),
+                    }
+                ),
+                file=sys.stderr,
+            )
+        except Exception as e:  # auxiliary line must never kill the run
+            print(f"# pruned headline failed: {e!r}", file=sys.stderr)
+
     if os.environ.get("S2VTPU_BENCH_SKIP_ADV", "") != "1":
         try:
             adversarial_line()
         except Exception as e:  # auxiliary line must never kill the run
             print(f"# adversarial line failed: {e!r}", file=sys.stderr)
     return 0
+
+
+def _prune_enabled() -> bool:
+    return os.environ.get("S2VTPU_BENCH_PRUNE") == "1"
+
+
+def _spec_depth() -> int:
+    return int(os.environ.get("S2VTPU_BENCH_SPEC_DEPTH", "0"))
+
+
+def _prune_counters(st) -> dict:
+    """Nonzero accelerator counters off a FrontierStats, for the metric
+    lines — the proof the fast path fired, not just that a flag was set."""
+    out = {}
+    for f in (
+        "prune_commits",
+        "prune_dead",
+        "prune_ranked",
+        "spec_launches",
+        "spec_layers",
+        "spec_accepts",
+        "spec_rollbacks",
+    ):
+        v = int(getattr(st, f, 0) or 0) if st is not None else 0
+        if v:
+            out[f] = v
+    return out
+
+
+def _prune_note(st) -> str:
+    c = _prune_counters(st)
+    return (
+        ", ".join(f"{k}={v}" for k, v in c.items())
+        if c
+        else "no prune counters fired"
+    )
 
 
 def _backend_marker() -> str:
@@ -594,6 +683,33 @@ def adversarial_line() -> None:
             f"# adversarial device: warm {warm:.1f}s, steady {dev_s:.2f}s, OK",
             file=sys.stderr,
         )
+        pruned_s = pstats = None
+        if _prune_enabled():
+            try:
+                pkw = dict(
+                    kw,
+                    prune=True,
+                    speculate_depth=_spec_depth(),
+                    collect_stats=True,
+                )
+                pres = check_device(hist, **pkw)  # warm the pruned program
+                assert pres.outcome == CheckOutcome.OK
+                t0 = time.monotonic()
+                pres = check_device(hist, **pkw)
+                pruned_s = time.monotonic() - t0
+                assert pres.outcome == CheckOutcome.OK
+                pstats = pres.stats
+                print(
+                    f"# adversarial pruned device: steady {pruned_s:.2f}s "
+                    f"({dev_s / pruned_s:.2f}x; {_prune_note(pstats)})",
+                    file=sys.stderr,
+                )
+            except Exception as e:
+                pruned_s = None
+                print(
+                    f"# adversarial pruned device failed: {e!r}",
+                    file=sys.stderr,
+                )
         probe_finished_s = None
         if native_budget > 0:
             from s2_verification_tpu.checker.native import check_native
@@ -646,6 +762,23 @@ def adversarial_line() -> None:
             ),
             file=sys.stderr,
         )
+        if pruned_s is not None:
+            print(
+                json.dumps(
+                    {
+                        "metric": f"adversarial_k{k}_device_wall_s_pruned",
+                        "value": round(pruned_s, 3),
+                        "unit": "s",
+                        # Same-instance un-pruned wall over pruned wall:
+                        # the accelerator speedup the ISSUE gate checks.
+                        "vs_baseline": round(dev_s / pruned_s, 2),
+                        "backend": _backend_marker(),
+                        "host_cpus": _host_cpus(),
+                        **_prune_counters(pstats),
+                    }
+                ),
+                file=sys.stderr,
+            )
         return
 
 
@@ -840,10 +973,31 @@ def main() -> int:
         "probe; an exceeded budget is reported as a bounded verdict with "
         "the partial result, not a bare DNF)",
     )
+    ap.add_argument(
+        "--prune",
+        action="store_true",
+        help="also measure the verdict-exact pruned search: re-time the "
+        "headline and adversarial instances with checker/prune.py armed "
+        "and emit *_pruned stderr metric lines whose vs_baseline is the "
+        "same-run un-pruned/pruned speedup (env form: S2VTPU_BENCH_PRUNE)",
+    )
+    ap.add_argument(
+        "--speculate-depth",
+        type=int,
+        default=None,
+        metavar="K",
+        help="speculative multi-layer expansion depth for the pruned "
+        "measurements (0 = pruning only; env form: "
+        "S2VTPU_BENCH_SPEC_DEPTH)",
+    )
     args = ap.parse_args()
     if args.budget is not None:
         # Via the env so the bounded measurement children inherit it.
         os.environ["S2VTPU_BENCH_ADV_NATIVE_BUDGET_S"] = str(args.budget)
+    if args.prune:
+        os.environ["S2VTPU_BENCH_PRUNE"] = "1"
+    if args.speculate_depth is not None:
+        os.environ["S2VTPU_BENCH_SPEC_DEPTH"] = str(args.speculate_depth)
     if args.mesh is not None:
         return mesh_scaling(args.mesh)
     return north_star()

@@ -200,6 +200,15 @@ extern "C" {
 // out_states_* receive the final candidate states when OK: *out_states_len
 // is the FULL set size; only min(size, out_states_cap) entries are written
 // (the caller re-invokes with a larger buffer on truncation).
+//
+// app_rank / inert carry the verdict-exact commutativity prunes
+// (checker/prune.py); both may be null (no pruning).  app_rank[j] >= 0
+// gives op j's dense position in the statically-forced successful-append
+// order (-1 = unranked): ranked calls are gated until exactly their turn,
+// since any other order provably never accepts.  inert[j] marks identity
+// ops: once an inert op's subtree at a position is exhausted, its DFS
+// siblings are skipped (sleep-set style) — any accepting order through a
+// sibling reorders to commit the identity op first, which already failed.
 int32_t s2_check(
     int32_t n_ops, const int32_t* op_type, const uint8_t* has_set_token,
     const int32_t* set_token, const uint8_t* has_batch_token,
@@ -209,7 +218,8 @@ int32_t s2_check(
     const uint32_t* rh_hi, const uint32_t* rh_lo, const uint8_t* out_failure,
     const uint8_t* out_definite, const uint32_t* out_tail,
     const uint8_t* out_has_hash, const uint64_t* out_hash,
-    const int32_t* call_time, const int32_t* ret_time, int32_t n_init,
+    const int32_t* call_time, const int32_t* ret_time,
+    const int32_t* app_rank, const uint8_t* inert, int32_t n_init,
     const uint32_t* init_tail, const uint64_t* init_hash,
     const int32_t* init_tok, double time_budget_s, int32_t* out_order,
     int32_t* out_order_len, uint32_t* out_states_tail,
@@ -280,6 +290,10 @@ int32_t s2_check(
   std::vector<Undo> calls;
   calls.reserve(n_ops);
 
+  // Ranked successful appends committed so far: the next one to commit
+  // must be exactly rank `next_rank` (ranks are dense over the history).
+  int32_t next_rank = 0;
+
   int64_t steps = 0, cache_hits = 0;
   const bool budgeted = time_budget_s > 0;
   const auto deadline = std::chrono::steady_clock::now() +
@@ -337,15 +351,24 @@ int32_t s2_check(
       calls.pop_back();
       int32_t j = entries[u.call_entry].op;
       bits[j >> 6] &= ~(1ULL << (j & 63));
+      if (app_rank && app_rank[j] >= 0) --next_rank;
       states = std::move(u.saved_states);
       unlift(u.call_entry);
-      entry = entries[u.call_entry].next;
+      // Inert-forced backtrack: siblings of an exhausted identity op are
+      // redundant (see the ABI comment) — pop straight through.
+      entry = (inert && inert[j]) ? -1 : entries[u.call_entry].next;
       continue;
     }
     Entry& e = entries[entry];
     if (e.is_call) {
-      ++steps;
       int32_t j = e.op;
+      if (app_rank && app_rank[j] >= 0 && app_rank[j] != next_rank) {
+        // Out-of-turn ranked append: no accepting linearization commits
+        // it here (successful-append tails are monotone) — skip.
+        entry = e.next;
+        continue;
+      }
+      ++steps;
       std::vector<State> ns = step_set(ops, j, states);
       if (!ns.empty()) {
         bits[j >> 6] |= 1ULL << (j & 63);
@@ -363,6 +386,7 @@ int32_t s2_check(
           bucket.push_back(std::move(key));
           calls.push_back(Undo{entry, std::move(states)});
           states = std::move(ns);
+          if (app_rank && app_rank[j] >= 0) ++next_rank;
           lift(entry);
           if (calls.size() > best_count) {
             best_count = calls.size();
@@ -386,9 +410,10 @@ int32_t s2_check(
       calls.pop_back();
       int32_t j = entries[u.call_entry].op;
       bits[j >> 6] &= ~(1ULL << (j & 63));
+      if (app_rank && app_rank[j] >= 0) --next_rank;
       states = std::move(u.saved_states);
       unlift(u.call_entry);
-      entry = entries[u.call_entry].next;
+      entry = (inert && inert[j]) ? -1 : entries[u.call_entry].next;
     }
   }
 

@@ -107,6 +107,7 @@ def check_batch_native(
     profile: bool = False,
     on_lane=None,
     progress=None,
+    prune: bool = False,
 ) -> list[LaneVerdict]:
     """Run each lane through the native engine without re-encoding.
 
@@ -143,6 +144,7 @@ def check_batch_native(
                 time_budget_s=lane.time_budget_s,
                 profile=profile,
                 enc=lane.enc,
+                prune=prune,
             )
             v = LaneVerdict(res, "batch-native", time.monotonic() - t0)
             if sink is not None:
@@ -187,6 +189,7 @@ def check_batch_vmap(
     skip=None,
     capacity: int = VMAP_LANE_CAPACITY,
     progress=None,
+    prune: bool = False,
 ) -> list[LaneVerdict]:
     """One vmapped frontier search over the whole launch group.
 
@@ -232,7 +235,7 @@ def check_batch_vmap(
                 None, "batch-vmap", 0.0, skipped="init-overflow"
             )
             continue
-        tables_list.append(build_tables(enc))
+        tables_list.append(build_tables(enc, prune=prune))
         live.append(i)
 
     if not live:

@@ -88,6 +88,7 @@ from ..utils.cache import enable_persistent_cache
 from .entries import History
 from .frontier import FrontierStats
 from .oracle import CheckOutcome, CheckResult
+from .prune import PIN_INF, RANK_INF
 from ..ops import u64
 from ..ops.step_kernel import DeviceOps, DeviceState, step_kernel
 
@@ -115,6 +116,10 @@ asarray = np.asarray
 
 _I32 = jnp.int32
 _U32 = jnp.uint32
+
+#: prune-table sentinels (checker/prune.py), as device scalars
+_RANK_INF = jnp.int32(RANK_INF)
+_PIN_INF = jnp.uint32(PIN_INF)
 
 #: Opt-in: exact sort dedup for tiny layers (see _expand_layer).  Read at
 #: import so the flag is uniform across every program this process traces.
@@ -149,6 +154,20 @@ class SearchTables(NamedTuple):
     #: that product overflows and the generic full-vector compare is used.
     pack_hi: jnp.ndarray  # [C] uint32
     pack_lo: jnp.ndarray  # [C] uint32
+    #: commutativity-prune tables (checker/prune.py).  Always present so
+    #: pruning on/off is a table-content change, never a retrace: neutral
+    #: fills (RANK_INF ranks, PIN_INF pins, all-false masks) make every
+    #: consumer a provable no-op.
+    #: per-op rank in the forced successful-append order (RANK_INF unranked)
+    app_rank: jnp.ndarray  # [N] int32
+    #: minrank_tab[c, k]: min rank among chain c ops at positions >= k
+    minrank_tab: jnp.ndarray  # [C, Lc+1] int32
+    #: pintail_tab[c, k]: min statically-pinned tail among those ops
+    pintail_tab: jnp.ndarray  # [C, Lc+1] uint32
+    #: per-op: identity on every state (eager-commit unconditionally)
+    inert: jnp.ndarray  # [N] bool
+    #: per-op: successful read/check_tail (eager-commit when it passes)
+    filter_succ: jnp.ndarray  # [N] bool
 
 
 class Frontier(NamedTuple):
@@ -176,6 +195,15 @@ class RunOut(NamedTuple):
     max_live: jnp.ndarray
     auto_closed: jnp.ndarray
     expanded: jnp.ndarray
+    #: prune counters: candidate filters/inert ops committed by the eager
+    #: sweep, and rows dropped by the tail-pin dead-row rule
+    eager_closed: jnp.ndarray
+    pin_killed: jnp.ndarray
+    #: speculation counters: dive layers advanced (incl. rolled back),
+    #: dives that found the accept, dives discarded on misprediction
+    spec_layers: jnp.ndarray
+    spec_accepts: jnp.ndarray
+    spec_rollbacks: jnp.ndarray
     #: counts of one live row of the deepest committed layer (diagnostics)
     deep_counts: jnp.ndarray  # [C] int32
     #: on STOP_CAPACITY: the aborted layer's unique-children count — the
@@ -212,12 +240,16 @@ def can_exact_pack(enc: EncodedHistory) -> bool:
     return _pack_strides(enc.chain_len)[0]
 
 
-def build_tables(enc: EncodedHistory) -> SearchTables:
+def build_tables(enc: EncodedHistory, prune: bool = False) -> SearchTables:
     # Padded length, not enc.num_ops: the derived masks must match the
     # (shape-bucketed) array sizes; padded entries are inert by
     # construction (trivial outputs, no tokens, in no chain).
     n = int(enc.op_type.shape[0])
     c, lc = enc.chain_ops.shape
+
+    from .prune import analyze_encoded, neutral_tables
+
+    pt = analyze_encoded(enc) if prune else neutral_tables(n, (c, lc))
 
     is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
     settable = set()
@@ -262,6 +294,11 @@ def build_tables(enc: EncodedHistory) -> SearchTables:
         zob2=jnp.asarray(zob[1]),
         pack_hi=jnp.asarray((strides >> np.uint64(32)).astype(np.uint32)),
         pack_lo=jnp.asarray(strides.astype(np.uint32)),
+        app_rank=jnp.asarray(pt.app_rank),
+        minrank_tab=jnp.asarray(pt.minrank_tab),
+        pintail_tab=jnp.asarray(pt.pintail_tab),
+        inert=jnp.asarray(pt.inert),
+        filter_succ=jnp.asarray(pt.filter_succ),
     )
 
 
@@ -372,35 +409,75 @@ def _next_and_cands(tables: SearchTables, counts):
     nret = jnp.where(has_next, ops.ret[nxt], INF_TIME)
     m = jnp.min(nret)
     cand = has_next & (ops.call[nxt] < m)
+    # Rank gate (checker/prune.py): successful appends linearize in
+    # out_tail order in every accepting interleaving, so a ranked
+    # candidate above the minimum remaining rank heads a branch that can
+    # never accept — drop it from the window.  Neutral tables (all
+    # RANK_INF) reduce the gate to `cand & True`.
+    minrank = jnp.min(
+        jnp.take_along_axis(tables.minrank_tab, counts[:, None], axis=1)[:, 0]
+    )
+    rank_nxt = tables.app_rank[nxt]
+    cand = cand & ((rank_nxt == _RANK_INF) | (rank_nxt <= minrank))
     return nxt, cand
 
 
-def _auto_close_row(tables: SearchTables, counts, tail, tok, cfg_valid):
-    """Advance one row past indefinite appends whose effect branch is dead.
+def _row_tail_pin(tables: SearchTables, counts):
+    """Smallest statically-pinned tail among one row's remaining ops."""
+    return jnp.min(
+        jnp.take_along_axis(tables.pintail_tab, counts[:, None], axis=1)[:, 0]
+    )
+
+
+def _auto_close_row(tables: SearchTables, counts, tail, hi, lo, tok, cfg_valid):
+    """Advance one row past candidate ops that are provably identity here.
 
     Tails are monotone along every path, so a stale ``match_seq_num`` can
     never match again; a fencing token no remaining op sets can never come
     to match either.  Linearizing such an op immediately (no-effect branch)
     is sound and complete — see frontier.py's auto-close notes.
+
+    With prune tables loaded, the same sweep also eager-commits inert ops
+    and successful filters that PASS this row's state (tail and, when
+    observed, hash): filters never mutate, so any accepting continuation
+    that linearizes one later can be reordered to linearize it now with
+    every other op seeing identical states (checker/prune.py).  Returns
+    ``(closed_counts, n_closed, n_eager)``; neutral tables make
+    ``n_eager`` identically zero.
     """
 
-    def dead_now(c):
+    def advance_now(c):
         nxt, cand = _next_and_cands(tables, c)
         ms = tables.ops.match_seq[nxt]
         bt = tables.ops.batch_token[nxt]
         dead = (tables.ac_match[nxt] & (tail > ms)) | (
             tables.ac_tok[nxt] & (tok != bt)
         )
-        return cand & dead
+        fpass = (
+            tables.filter_succ[nxt]
+            & (tail == tables.ops.out_tail[nxt])
+            & (
+                ~tables.ops.out_has_hash[nxt]
+                | (
+                    (hi == tables.ops.out_hash_hi[nxt])
+                    & (lo == tables.ops.out_hash_lo[nxt])
+                )
+            )
+        )
+        eager = cand & (tables.inert[nxt] | fpass) & ~dead
+        return cand & dead | eager, eager
 
-    def cond(c):
-        return cfg_valid & dead_now(c).any()
+    def cond(st):
+        c, _ne = st
+        return cfg_valid & advance_now(c)[0].any()
 
-    def body(c):
-        return c + dead_now(c).astype(_I32)
+    def body(st):
+        c, ne = st
+        adv, eager = advance_now(c)
+        return c + adv.astype(_I32), ne + eager.astype(_I32).sum()
 
-    closed = lax.while_loop(cond, body, counts)
-    return closed, (closed - counts).sum()
+    closed, n_eager = lax.while_loop(cond, body, (counts, jnp.zeros((), _I32)))
+    return closed, (closed - counts).sum(), n_eager
 
 
 def _accept_one(tables: SearchTables, counts, cfg_valid):
@@ -1122,6 +1199,121 @@ def _expand_layer_chunked(
     )
 
 
+def _spec_dive(
+    tables: SearchTables,
+    init: "RunOut",
+    depth: int,
+    width: int,
+    exact_pack: bool,
+    sort_dedup: bool,
+    pallas_fold: bool,
+) -> "RunOut":
+    """One speculative beam dive per launch, inside the compiled program.
+
+    Copies the ``width`` best rows off the (closed, pinned) entry frontier
+    — value-ordered by the lazy beam priority, fewest linearized
+    indefinite appends first — and expands them up to ``depth`` layers,
+    checking for an accept after each.  Every dive row is a real reachable
+    configuration (each layer step-validates its states through the exact
+    expansion kernel), so finding an accepting row is conclusive: the dive
+    returns an accept carry with ``layers`` advanced by the dive depth.  A
+    dive that exhausts its depth (or its beam) without accepting is
+    discarded wholesale — the entry carry passes through untouched except
+    for the speculation counters, and the exact single-layer loop proceeds
+    as if the dive never ran.
+    """
+    src = init.frontier
+    f = src.valid.shape[0]
+
+    closed_counts, _n, _ne = jax.vmap(partial(_auto_close_row, tables))(
+        src.counts, src.tail, src.hi, src.lo, src.tok, src.valid
+    )
+    pin = jax.vmap(partial(_row_tail_pin, tables))(closed_counts)
+    valid = src.valid & ~(src.tail > pin)
+    opens = jax.vmap(
+        lambda cnt: jnp.take_along_axis(tables.opens_tab, cnt[:, None], axis=1)[
+            :, 0
+        ].sum()
+    )(closed_counts)
+    key = jnp.where(
+        valid, jnp.minimum(opens, _OPENS_CAP), jnp.int32(2 * _OPENS_CAP)
+    )
+    order = jnp.argsort(key)[:width]
+    beam = Frontier(
+        counts=closed_counts[order],
+        tail=src.tail[order],
+        hi=src.hi[order],
+        lo=src.lo[order],
+        tok=src.tok[order],
+        valid=valid[order],
+    )
+
+    def acc_of(fr):
+        return jax.vmap(partial(_accept_one, tables))(fr.counts, fr.valid)
+
+    def cond(st):
+        fr, k, done = st
+        return ~done & (k < depth) & fr.valid.any()
+
+    def step(st):
+        fr, k, _done = st
+        children = _expand_layer(
+            tables,
+            fr,
+            allow_prune=True,
+            exact_pack=exact_pack,
+            sort_dedup=sort_dedup,
+            pallas_fold=pallas_fold,
+        )[0]
+        ccounts, _cn, _ce = jax.vmap(partial(_auto_close_row, tables))(
+            children.counts,
+            children.tail,
+            children.hi,
+            children.lo,
+            children.tok,
+            children.valid,
+        )
+        cpin = jax.vmap(partial(_row_tail_pin, tables))(ccounts)
+        nfr = children._replace(
+            counts=ccounts, valid=children.valid & ~(children.tail > cpin)
+        )
+        return nfr, k + 1, acc_of(nfr).any()
+
+    # An already-accepting entry frontier is the exact loop's business
+    # (it owns the real accept bookkeeping); the dive stands down.
+    entry_acc = acc_of(beam).any()
+    fr, k, _done = lax.while_loop(
+        cond, step, (beam, jnp.zeros((), _I32), entry_acc)
+    )
+    acc = acc_of(fr)
+    found = acc.any() & ~entry_acc
+    idx = jnp.argmax(acc)
+
+    acc_frontier = Frontier(
+        counts=src.counts.at[0].set(fr.counts[idx]),
+        tail=src.tail.at[0].set(fr.tail[idx]),
+        hi=src.hi.at[0].set(fr.hi[idx]),
+        lo=src.lo.at[0].set(fr.lo[idx]),
+        tok=src.tok.at[0].set(fr.tok[idx]),
+        valid=jnp.zeros(f, bool).at[0].set(True),
+    )
+    new_frontier = jax.tree.map(
+        lambda a, b: jnp.where(found, a, b), acc_frontier, src
+    )
+    return init._replace(
+        frontier=new_frontier,
+        stop_code=jnp.where(found, jnp.int32(STOP_ACCEPT), init.stop_code).astype(
+            _I32
+        ),
+        accept_idx=jnp.where(found, 0, init.accept_idx).astype(_I32),
+        layers=init.layers + jnp.where(found, k, 0),
+        deep_counts=jnp.where(found, fr.counts[idx], init.deep_counts),
+        spec_layers=init.spec_layers + k,
+        spec_accepts=init.spec_accepts + found.astype(_I32),
+        spec_rollbacks=init.spec_rollbacks + ((~found) & (k > 0)).astype(_I32),
+    )
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -1131,6 +1323,8 @@ def _expand_layer_chunked(
         "sort_dedup",
         "chunk_rows",
         "pallas_fold",
+        "spec_depth",
+        "spec_width",
     ),
 )
 def run_search(
@@ -1144,6 +1338,8 @@ def run_search(
     sort_dedup: bool = False,
     chunk_rows: int = 0,
     pallas_fold: bool = False,
+    spec_depth: int = 0,
+    spec_width: int = 0,
 ) -> RunOut:
     """Run the frontier search to a verdict inside one compiled while_loop.
 
@@ -1166,15 +1362,36 @@ def run_search(
     lexicographic sort over the full child identity: perfect dedup, no
     colliding scatters — the variant built for TPU, where scatter updates
     on colliding indices serialize.
+
+    ``spec_depth > 0`` prepends one speculative dive per launch
+    (:func:`_spec_dive`): a ``spec_width``-row value-ordered beam copied
+    off the entry frontier expands up to ``spec_depth`` layers inside the
+    same compiled program, checking for an accept after each.  Dive rows
+    are real reachable configurations (each expansion step-validates its
+    states), so a dive accept is conclusive and returns immediately with
+    ``layers`` advanced by the whole dive depth; a dive that finds
+    nothing is discarded wholesale (``spec_rollbacks``) and the exact
+    loop proceeds from the untouched entry frontier.  Incompatible with
+    the witness log (``log_layers`` must be 0 when ``spec_depth > 0``) —
+    a speculative accept recovers its linearization from the accept
+    counts instead.
     """
+    assert not (spec_depth and log_layers), "speculation drops the witness log"
 
     def body(carry: RunOut) -> RunOut:
         cur = carry.frontier
 
-        closed_counts, ac_n = jax.vmap(partial(_auto_close_row, tables))(
-            cur.counts, cur.tail, cur.tok, cur.valid
+        closed_counts, ac_n, eager_n = jax.vmap(partial(_auto_close_row, tables))(
+            cur.counts, cur.tail, cur.hi, cur.lo, cur.tok, cur.valid
         )
         closed = cur._replace(counts=closed_counts)
+        # Tail-pin dead rows (checker/prune.py): a row whose tail has
+        # passed the smallest statically-pinned tail among its remaining
+        # ops can never linearize that op — drop it.  Exact (the row has
+        # no accepting extension), and a no-op under neutral tables.
+        pin = jax.vmap(partial(_row_tail_pin, tables))(closed.counts)
+        pin_dead = closed.valid & (closed.tail > pin)
+        closed = closed._replace(valid=closed.valid & ~pin_dead)
         acc_row = jax.vmap(partial(_accept_one, tables))(closed.counts, closed.valid)
         accept_any = acc_row.any()
 
@@ -1305,6 +1522,12 @@ def run_search(
             # capacity stop is post-auto-close, so that work IS committed and
             # will not be replayed.
             auto_closed=carry.auto_closed + jnp.where(cur.valid, ac_n, 0).sum(),
+            eager_closed=carry.eager_closed
+            + jnp.where(cur.valid, eager_n, 0).sum(),
+            pin_killed=carry.pin_killed + pin_dead.astype(_I32).sum(),
+            spec_layers=carry.spec_layers,
+            spec_accepts=carry.spec_accepts,
+            spec_rollbacks=carry.spec_rollbacks,
             expanded=carry.expanded
             + jnp.where(committed, expanded, jnp.zeros((), _I32)),
             deep_counts=jnp.where(committed, deep_new, carry.deep_counts),
@@ -1327,11 +1550,26 @@ def run_search(
         max_live=frontier.valid.sum().astype(_I32),
         auto_closed=zero,
         expanded=zero,
+        eager_closed=zero,
+        pin_killed=zero,
+        spec_layers=zero,
+        spec_accepts=zero,
+        spec_rollbacks=zero,
         deep_counts=frontier.counts[0],
         want=zero,
         wparent=jnp.zeros((log_layers, frontier.valid.shape[0]), _I32),
         wop=jnp.full((log_layers, frontier.valid.shape[0]), -1, _I32),
     )
+    if spec_depth > 0 and spec_width > 0:
+        init = _spec_dive(
+            tables,
+            init,
+            spec_depth,
+            min(spec_width, frontier.valid.shape[0]),
+            exact_pack,
+            sort_dedup,
+            pallas_fold,
+        )
     return lax.while_loop(cond, body, init)
 
 
@@ -1394,9 +1632,9 @@ def _accept_sweep_device(tables: SearchTables, fr: Frontier, accept_counts):
     accept set.  The accept check runs post-auto-close in the compiled
     layer, so the sweep applies the same (deterministic) closure before
     matching."""
-    closed, _ = jax.vmap(
-        lambda cnt, tl, tk, v: _auto_close_row(tables, cnt, tl, tk, v)
-    )(fr.counts, fr.tail, fr.tok, fr.valid)
+    closed, _, _ = jax.vmap(partial(_auto_close_row, tables))(
+        fr.counts, fr.tail, fr.hi, fr.lo, fr.tok, fr.valid
+    )
     match = fr.valid & (closed == accept_counts[None, :]).all(axis=1)
     _, tail, hi, lo, tok, n = _compact_rows_device(fr._replace(valid=match))
     return tail, hi, lo, tok, n
@@ -1516,6 +1754,9 @@ def check_device(
     device_rows_cap: int = 0,
     pallas_fold: bool | None = None,
     progress=None,
+    prune: bool = False,
+    speculate_depth: int = 0,
+    speculate_width: int = 64,
 ) -> CheckResult:
     """Decide linearizability on device.  Verdict semantics match
     :func:`..checker.frontier.check_frontier`: OK and un-pruned ILLEGAL are
@@ -1583,6 +1824,20 @@ def check_device(
     regains control only at compiled-segment boundaries, so that is the
     honest heartbeat cadence — one offer per segment, from scalars the
     driver already fetched.
+
+    ``prune=True`` activates the verdict-exact commutativity prunes
+    (:mod:`.prune`): the append rank gate, eager commit of inert and
+    passing-filter candidates, and tail-pin dead-row elimination.  Never a
+    verdict change — OK, ILLEGAL and UNKNOWN are all preserved (unlike the
+    beam, these prunes never set ``stats.pruned``).
+
+    ``speculate_depth > 0`` runs one speculative beam dive per compiled
+    launch (:func:`_spec_dive`): the best ``speculate_width`` rows expand
+    up to ``speculate_depth`` layers inside the same program, conclusively
+    accepting if a dive row accepts and rolling back wholesale otherwise.
+    Incompatible with the per-layer witness log — speculation is silently
+    disabled while the log is active (an OK verdict still recovers its
+    witness via :func:`_recover_witness_bounded`).
     """
     del state_slots
     collect_stats = collect_stats or profile
@@ -1613,7 +1868,12 @@ def check_device(
         if collect_stats:
             res.stats = stats  # type: ignore[attr-defined]
         return res
-    tables = build_tables(enc)
+    tables = build_tables(enc, prune=prune)
+    prune_pt = None
+    if prune:
+        from .prune import analyze_encoded
+
+        prune_pt = analyze_encoded(enc)
     xp = can_exact_pack(enc) if exact_pack is None else bool(exact_pack)
     # Sort-based dedup needs the packed identity.  An explicit
     # sort_dedup=True on an unpackable history refuses (same contract as
@@ -1842,6 +2102,11 @@ def check_device(
             # unpackable history whose zeroed strides would alias every
             # identity) must run the one-shot expander at width f instead.
             chunk_rows=f_cap if (big_cap > f_cap and f > f_cap) else 0,
+            # Speculation shares the launch with the witness log in no
+            # compiled program (the dive cannot record per-layer parents);
+            # while the log is live the dive stands down.
+            spec_depth=0 if witness else int(speculate_depth),
+            spec_width=int(speculate_width) if speculate_depth else 0,
         )
         # Scalar-only fetch: the frontier itself stays on device.  Pulling
         # the whole frontier back per segment (the previous design) moved
@@ -1859,6 +2124,11 @@ def check_device(
             accept_idx,
             deep_np,
             live,
+            seg_eager,
+            seg_pin,
+            seg_spec_layers,
+            seg_spec_accepts,
+            seg_spec_rollbacks,
         ) = device_get(
             (
                 out.stop_code,
@@ -1871,6 +2141,11 @@ def check_device(
                 out.accept_idx,
                 out.deep_counts,
                 out.frontier.valid.sum(),
+                out.eager_closed,
+                out.pin_killed,
+                out.spec_layers,
+                out.spec_accepts,
+                out.spec_rollbacks,
             )
         )
         code = int(code)
@@ -1889,6 +2164,13 @@ def check_device(
         # candidate-set-width statistic is meaningful only for host engines.
         stats.auto_closed += int(seg_auto_closed)
         stats.expanded += int(seg_expanded)
+        stats.prune_commits += int(seg_eager)
+        stats.prune_dead += int(seg_pin)
+        stats.spec_layers += int(seg_spec_layers)
+        stats.spec_accepts += int(seg_spec_accepts)
+        stats.spec_rollbacks += int(seg_spec_rollbacks)
+        if speculate_depth and not witness:
+            stats.spec_launches += 1
         seg_shards = None
         if mesh is not None and collect_stats:
             seg_shards, sync_s = _shard_occupancy(out.frontier, mesh)
@@ -1934,7 +2216,7 @@ def check_device(
                     wlogs.append((rows, wp[l][rows], wo[l][rows]))
         if code == STOP_ACCEPT:
             lin = (
-                _witness_linearization(enc, wlogs, int(accept_idx))
+                _witness_linearization(enc, wlogs, int(accept_idx), pt=prune_pt)
                 if witness
                 else None
             )
@@ -2037,10 +2319,24 @@ def check_device(
     return res
 
 
-def _host_close(enc: EncodedHistory, counts, tail: int, tok: int) -> list[int]:
+def _host_close(
+    enc: EncodedHistory,
+    counts,
+    tail: int,
+    tok: int,
+    h: int | None = None,
+    pt=None,
+) -> list[int]:
     """Host mirror of :func:`_auto_close_row`: advance every dead candidate
     (all at once per sweep, chain order within a sweep) until a fixpoint;
-    returns the encoded op indices closed, mutating ``counts``."""
+    returns the encoded op indices closed, mutating ``counts``.
+
+    ``pt`` (a :class:`..checker.prune.PruneTables`) mirrors the eager-commit
+    branch of a pruned device run: inert candidates close unconditionally
+    and successful filters close when they pass the row's state (``tail``
+    plus, when ``h`` — the full 64-bit stream hash — is given, the hash
+    guard).  Required for witness replay of a ``prune=True`` search, whose
+    logged expansion path excludes eagerly-closed ops."""
     is_indef = enc.out_failure & ~enc.out_definite & (enc.op_type == 0)
     settable = {int(enc.set_token[j]) for j in range(enc.num_ops) if enc.has_set_token[j]}
     closed: list[int] = []
@@ -2049,6 +2345,25 @@ def _host_close(enc: EncodedHistory, counts, tail: int, tok: int) -> list[int]:
         dead = []
         for c in np.flatnonzero(cand):
             j = nxt[c]
+            if pt is not None:
+                if pt.inert[j]:
+                    dead.append(c)
+                    continue
+                if (
+                    pt.filter_succ[j]
+                    and (tail & 0xFFFFFFFF) == int(enc.out_tail[j])
+                    and (
+                        not enc.out_has_hash[j]
+                        or (
+                            h is not None
+                            and (h & 0xFFFFFFFFFFFFFFFF)
+                            == (int(enc.out_hash_hi[j]) << 32)
+                            | int(enc.out_hash_lo[j])
+                        )
+                    )
+                ):
+                    dead.append(c)
+                    continue
             if not is_indef[j]:
                 continue
             if enc.has_match[j] and tail > int(enc.match_seq[j]):
@@ -2082,7 +2397,7 @@ def _host_next_cands(enc: EncodedHistory, counts):
 
 
 def _witness_linearization(
-    enc: EncodedHistory, wlogs, accept_idx: int
+    enc: EncodedHistory, wlogs, accept_idx: int, pt=None
 ) -> list[int] | None:
     """Recover a concrete linearization from the accept row's logged path.
 
@@ -2133,7 +2448,7 @@ def _witness_linearization(
 
     for opbr in path:
         j, br = opbr // 2, opbr % 2
-        order.extend(_host_close(enc, counts, tail, tok))
+        order.extend(_host_close(enc, counts, tail, tok, h=h, pt=pt))
         nxt, cand = _host_next_cands(enc, counts)
         c = int(enc.chain_of[j])
         if not cand[c] or int(nxt[c]) != j:
@@ -2143,7 +2458,7 @@ def _witness_linearization(
         order.append(j)
         if br == 0:
             apply_effect(j)
-    order.extend(_host_close(enc, counts, tail, tok))
+    order.extend(_host_close(enc, counts, tail, tok, h=h, pt=pt))
 
     remaining = _accept_remaining(enc, counts)
     if remaining is None:
@@ -2903,6 +3218,9 @@ def check_device_auto(
     spill_host_cap: int = 1 << 26,
     device_rows_cap: int | None = None,
     progress=None,
+    prune: bool = False,
+    speculate_depth: int = 0,
+    speculate_width: int = 64,
 ) -> CheckResult:
     """Beam-first device check with exhaustive escalation, mirroring
     :func:`..checker.frontier.check_frontier_auto`.
@@ -2974,6 +3292,9 @@ def check_device_auto(
             witness=witness,
             witness_max_frontier=witness_max_frontier,
             progress=progress,
+            prune=prune,
+            speculate_depth=speculate_depth,
+            speculate_width=speculate_width,
         )
         if res.outcome != CheckOutcome.UNKNOWN:
             if marker is not None:
@@ -3004,6 +3325,9 @@ def check_device_auto(
         spill_host_cap=spill_host_cap,
         device_rows_cap=device_rows_cap,
         progress=progress,
+        prune=prune,
+        speculate_depth=speculate_depth,
+        speculate_width=speculate_width,
     )
     # On a conclusive verdict the marker is spent.  On UNKNOWN it stays,
     # paired with the kept exhaustive snapshot: a retry (e.g. with a larger

@@ -99,6 +99,21 @@ class FrontierStats:
     auto_closed: int = 0
     expanded: int = 0
     pruned: bool = False
+    #: verdict-exact commutativity-prune counters (``prune=True`` runs;
+    #: see checker/prune.py — these never imply ``pruned``/UNKNOWN):
+    #: candidates eagerly committed (inert or passing-filter), rows or
+    #: configurations killed by the tail-pin bound, and candidate
+    #: expansions skipped by the append rank gate (host engine only).
+    prune_commits: int = 0
+    prune_dead: int = 0
+    prune_ranked: int = 0
+    #: speculative-dive counters (device engine, ``speculate_depth > 0``):
+    #: launches with a dive armed, total speculated layers, dives that
+    #: conclusively accepted, dives discarded (rolled back).
+    spec_launches: int = 0
+    spec_layers: int = 0
+    spec_accepts: int = 0
+    spec_rollbacks: int = 0
     #: per-layer profile entries (``profile=True`` runs only): each is
     #: ``{"layer", "frontier", "states", "auto_closed", "elapsed_s"}`` —
     #: host search appends one per BFS layer, the device search one per
@@ -147,6 +162,7 @@ def check_frontier(
     complete_cuts: bool = False,
     time_budget_s: float | None = None,
     progress=None,
+    prune: bool = False,
 ) -> CheckResult:
     """Decide linearizability by frontier BFS.  Verdict matches the DFS.
 
@@ -194,6 +210,17 @@ def check_frontier(
     layer offers ``(ops committed, total ops, frontier width, states
     expanded)`` and the sink time-gates what actually leaves — one clock
     read per layer on the fast path.
+
+    ``prune=True`` activates the verdict-exact commutativity prunes
+    (checker/prune.py): eager commit of inert / passing-filter candidates
+    inside the auto-close sweep, the append rank gate, and tail-pin
+    dead-configuration elimination.  All three preserve OK, ILLEGAL *and*
+    UNKNOWN (they never set ``stats.pruned``).  While ``snapshot_cuts``
+    are collecting, the rank gate and pin kill stand down — gated branches
+    never accept but can still contribute states to a cut union, and the
+    snapshot contract promises the *exact* reachable union (see
+    checker/prefix.py); eager commit reaches identical unions (filters are
+    identity where they commit) and stays active.
     """
     collect_stats = collect_stats or profile
     ops = history.ops
@@ -239,6 +266,15 @@ def check_frontier(
         cut = cuts.get(sum(counts))
         if cut is not None and not cut[2] and counts == cut[0]:
             cut[1].update(states)
+
+    plan = None
+    if prune:
+        from .prune import analyze_history
+
+        plan = analyze_history(history)
+    # Rank gate + pin kill vs snapshot cuts: see the docstring — both
+    # stand down while cuts are collecting so unions stay exact.
+    order_prunes = plan is not None and not cuts
     # Witness links: cfg -> (parent cfg, ops auto-closed at the parent's
     # layer, the expanded op) — walked backwards on accept to recover a
     # concrete linearization (same role as the device engine's witness log).
@@ -332,10 +368,29 @@ def check_frontier(
             _, cands = window(tuple(counts))
             for c in cands:
                 op = next_op(tuple(counts), c)
-                if _op_dead_forever(op, states, settable_tokens):
+                eager = False
+                if plan is not None:
+                    j = chains[c][counts[c]]
+                    if j in plan.inert:
+                        eager = True
+                    else:
+                        guard = plan.filter_guard.get(j)
+                        if guard is not None:
+                            t, hsh = guard
+                            # Identity only where it passes EVERY state —
+                            # a partial pass filters the set.
+                            eager = all(
+                                s.tail == t
+                                and (hsh is None or s.stream_hash == hsh)
+                                for s in states
+                            )
+                if eager or _op_dead_forever(op, states, settable_tokens):
                     closed_ops.append(chains[c][counts[c]])
                     counts[c] += 1
-                    stats.auto_closed += 1
+                    if eager:
+                        stats.prune_commits += 1
+                    else:
+                        stats.auto_closed += 1
                     if cuts:
                         # Auto-close leaves states untouched, so each
                         # intermediate position is a reachable cut config.
@@ -464,9 +519,24 @@ def check_frontier(
 
         children: dict[tuple[tuple[int, ...], frozenset[StreamState]], None] = {}
         for counts, states in closed:
+            if order_prunes and min(s.tail for s in states) > plan.min_pin(counts):
+                # Every state's tail has passed the smallest pin among the
+                # remaining ops: that op can never linearize from here, so
+                # no accepting extension exists — exact, unlike the beam.
+                stats.prune_dead += 1
+                continue
             pre, closed_ops = close_link[(counts, states)]
             _, cands = window(counts)
+            minrank = plan.min_remaining_rank(counts) if order_prunes else None
             for c in cands:
+                if order_prunes:
+                    r = plan.rank.get(chains[c][counts[c]])
+                    if r is not None and r > minrank:
+                        # A later-ranked append before an earlier-ranked
+                        # one cannot appear in any accepting
+                        # linearization (tails are monotone).
+                        stats.prune_ranked += 1
+                        continue
                 op = next_op(counts, c)
                 new_states = step_set(sorted(states), op.inp, op.out)
                 stats.expanded += 1
@@ -530,6 +600,7 @@ def check_frontier_auto(
     snapshot_cuts: Iterable[int] | None = None,
     time_budget_s: float | None = None,
     progress=None,
+    prune: bool = False,
 ) -> CheckResult:
     """Beam-first frontier check with exhaustive escalation.
 
@@ -552,6 +623,7 @@ def check_frontier_auto(
         snapshot_cuts=snapshot_cuts,
         time_budget_s=time_budget_s,
         progress=progress,
+        prune=prune,
     )
     if res.outcome != CheckOutcome.UNKNOWN:
         return res
@@ -566,4 +638,5 @@ def check_frontier_auto(
         snapshot_cuts=snapshot_cuts,
         time_budget_s=time_budget_s,
         progress=progress,
+        prune=prune,
     )

@@ -108,6 +108,7 @@ def check_native(
     profile: bool = False,
     enc: EncodedHistory | None = None,
     progress=None,
+    prune: bool = False,
 ) -> CheckResult:
     """Decide linearizability with the native engine.
 
@@ -130,6 +131,12 @@ def check_native(
     search is one blocking call, so only two offers are possible: a rate
     baseline before the search and a final heartbeat after it (the sink's
     trivial-job rule keeps fast runs silent).
+
+    ``prune=True`` hands the DFS the verdict-exact precedence tables from
+    :mod:`.prune` (the ``enc=``-derived append rank order plus the inert
+    mask): ranked successful appends are gated to their forced order and
+    exhausted identity-op subtrees skip their siblings.  Verdicts are
+    unchanged — OK, ILLEGAL and UNKNOWN all match ``prune=False``.
     """
     lib = _load()
     t_enc0 = _time.monotonic() if profile else 0.0
@@ -167,6 +174,18 @@ def check_native(
     out_hash = (enc.out_hash_hi.astype(np.uint64) << np.uint64(32)) | enc.out_hash_lo.astype(
         np.uint64
     )
+    if prune:
+        from .prune import RANK_INF, analyze_encoded
+
+        pt = analyze_encoded(enc)
+        app_rank = np.ascontiguousarray(
+            np.where(pt.app_rank == RANK_INF, np.int32(-1), pt.app_rank),
+            np.int32,
+        )
+        inert = _u8(pt.inert)
+    else:
+        app_rank = np.full(max(1, n), -1, np.int32)
+        inert = np.zeros(max(1, n), np.uint8)
     order = np.zeros(max(1, n), np.int32)
     order_len = ct.c_int32(0)
     states_cap = _states_cap
@@ -202,6 +221,8 @@ def check_native(
         _ptr(np.ascontiguousarray(out_hash, np.uint64), u64),
         _ptr(np.ascontiguousarray(enc.call, np.int32), i32),
         _ptr(np.ascontiguousarray(enc.ret, np.int32), i32),
+        _ptr(app_rank, i32),
+        _ptr(inert, u8),
         ct.c_int32(len(init)),
         _ptr(init_tail, u32),
         _ptr(init_hash, u64),

@@ -76,7 +76,11 @@ class ProgressSink:
         now = self._time()
         if self._ref is None:
             self._started = now
-            self._ref = (now, int(layer or 0), int(ops_committed))
+            self._ref = (
+                now,
+                int(layer) if layer is not None else 0,
+                int(ops_committed),
+            )
             if not final:
                 return False
         since = self._last_emit if self._last_emit is not None else self._started
@@ -87,6 +91,10 @@ class ProgressSink:
                 return False
         ref_t, ref_layer, ref_ops = self._ref
         dt = max(now - ref_t, 1e-9)
+        # ``layer`` is cumulative, so a multi-layer jump (a speculative
+        # K-layer launch, a multi-op fast stretch) is attributed in full:
+        # the rate is the layer DELTA over the interval, never "one
+        # heartbeat = one layer".
         if layer is not None:
             rate = (int(layer) - ref_layer) / dt
         else:
@@ -104,7 +112,15 @@ class ProgressSink:
             rec["layer"] = int(layer)
         if self.lane is not None:
             rec["lane"] = self.lane
-        self._ref = (now, int(layer or 0), int(ops_committed))
+        # A layer-less offer (native engine, service-side folds) carries
+        # the previous layer baseline forward — resetting it to 0 would
+        # inflate the next layer-bearing update's rate by the whole
+        # cumulative layer count.
+        self._ref = (
+            now,
+            int(layer) if layer is not None else ref_layer,
+            int(ops_committed),
+        )
         self._last_emit = now
         self.emitted += 1
         self._emit(rec)

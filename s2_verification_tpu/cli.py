@@ -113,23 +113,35 @@ def _read_events(path: str) -> list[ev.LabeledEvent]:
 
 
 def _cpu_check(
-    hist: History, budget: float | None, profile: bool = False
+    hist: History,
+    budget: float | None,
+    profile: bool = False,
+    prune: bool = False,
 ) -> CheckResult:
     """Native engine when buildable, Python oracle otherwise."""
     from .checker.native import NativeUnavailable, check_native
 
     try:
-        return check_native(hist, time_budget_s=budget, profile=profile)
+        return check_native(
+            hist, time_budget_s=budget, profile=profile, prune=prune
+        )
     except NativeUnavailable as e:
         log.debug("native checker unavailable (%s); using the Python oracle", e)
         return check(hist, time_budget_s=budget)
 
 
-def _cpu(hist: History, budget: float | None, profile: bool) -> CheckResult:
-    # profile only when asked: test doubles for _cpu_check keep the plain
-    # (hist, budget) signature.
+def _cpu(
+    hist: History, budget: float | None, profile: bool, prune: bool = False
+) -> CheckResult:
+    # Extra kwargs only when asked: test doubles for _cpu_check keep the
+    # plain (hist, budget) signature.
+    kw = {}
     if profile:
-        return _cpu_check(hist, budget, profile=True)
+        kw["profile"] = True
+    if prune:
+        kw["prune"] = True
+    if kw:
+        return _cpu_check(hist, budget, **kw)
     return _cpu_check(hist, budget)
 
 
@@ -141,6 +153,8 @@ def _run_backend(
     device_rows: int | None = None,
     collect_stats: bool = False,
     profile: bool = False,
+    prune: bool = False,
+    speculate_depth: int = 0,
 ) -> CheckResult:
     # Budget 0 = run to completion, the reference's unbounded default
     # (CheckEventsVerbose timeout 0, main.go:606).
@@ -167,18 +181,24 @@ def _run_backend(
     if backend == "native":
         from .checker.native import check_native
 
-        return check_native(hist, time_budget_s=time_budget_s, profile=profile)
+        return check_native(
+            hist, time_budget_s=time_budget_s, profile=profile, prune=prune
+        )
     if backend == "frontier":
         from .checker.frontier import check_frontier_auto
 
         return check_frontier_auto(
-            hist, collect_stats=collect_stats, profile=profile
+            hist, collect_stats=collect_stats, profile=profile, prune=prune
         )
     dev_kw = {} if device_rows is None else {"device_rows_cap": device_rows}
     if collect_stats:
         dev_kw["collect_stats"] = True
     if profile:
         dev_kw["profile"] = True
+    if prune:
+        dev_kw["prune"] = True
+    if speculate_depth:
+        dev_kw["speculate_depth"] = int(speculate_depth)
     if backend == "device":
         pin_platform()
         from .checker.device import check_device_auto
@@ -187,9 +207,9 @@ def _run_backend(
     if backend == "auto":
         if unbounded:
             # Never concede a decidable instance: CPU runs to completion.
-            return _cpu(hist, None, profile)
+            return _cpu(hist, None, profile, prune)
         budget = time_budget_s if time_budget_s is not None else 10.0
-        res = _cpu(hist, budget, profile)
+        res = _cpu(hist, budget, profile, prune)
         if res.outcome != CheckOutcome.UNKNOWN:
             return res
         log.info(
@@ -210,7 +230,7 @@ def _run_backend(
             "device search inconclusive; falling back to the unbounded "
             "CPU engine (no -time-budget was set)"
         )
-        return _cpu(hist, None, profile)
+        return _cpu(hist, None, profile, prune)
     raise ValueError(f"unknown backend {backend!r}")
 
 
@@ -297,6 +317,8 @@ def _check_one(args: argparse.Namespace, file_path: str) -> int:
             device_rows=args.device_rows,
             collect_stats=args.stats,
             profile=bool(args.profile),
+            prune=args.prune,
+            speculate_depth=args.speculate_depth,
         )
     except Exception as e:  # backend/environment failure, not a verdict
         from .checker.checkpoint import CheckpointError
@@ -650,6 +672,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         prefix_min_ops=args.prefix_min_ops,
         prefix_cuts=args.prefix_cuts,
         prefix_max_segments=args.prefix_max_segments,
+        prune=args.prune,
+        speculate_depth=args.speculate_depth,
     )
     daemon = Verifyd(cfg)
 
@@ -2024,6 +2048,23 @@ def build_parser() -> argparse.ArgumentParser:
         "it)",
     )
     c.add_argument(
+        "--prune",
+        action="store_true",
+        help="verdict-exact search pruning (checker/prune.py): forced "
+        "append order, eager commit of inert/passing-filter ops, "
+        "tail-pin dead-configuration elimination — same verdicts, "
+        "smaller search (parity gated by `make prune`)",
+    )
+    c.add_argument(
+        "--speculate-depth",
+        type=int,
+        default=0,
+        metavar="K",
+        help="speculative multi-layer expansion for the device search: "
+        "one K-layer dive per launch, wholesale-discarded on "
+        "misprediction (0 = off; disabled for witness-carrying runs)",
+    )
+    c.add_argument(
         "-no-viz", "--no-viz", action="store_true", help="skip the HTML artifact"
     )
     c.add_argument(
@@ -2384,6 +2425,26 @@ def build_parser() -> argparse.ArgumentParser:
         default=True,
         help="disable the fused single-pass admission parser and decode "
         "every submission through the layered event decoder",
+    )
+    s.add_argument(
+        "--prune",
+        action="store_true",
+        help="verdict-exact search pruning on every engine that carries "
+        "it: successful appends expand in their forced tail order, inert "
+        "ops and state-passing filters commit eagerly, and tail-pinned "
+        "dead configurations drop — same verdicts, smaller search "
+        "(checker/prune.py; parity gated by `make prune`)",
+    )
+    s.add_argument(
+        "--speculate-depth",
+        type=int,
+        default=0,
+        metavar="K",
+        help="speculative multi-layer frontier expansion for device "
+        "escalations: one narrow K-layer dive per launch along the "
+        "value-ordered beam, accepted only when it reaches a conclusive "
+        "accept, wholesale-discarded on misprediction (0 = off; "
+        "internally disabled for witness-carrying runs)",
     )
     s.add_argument(
         "--prefix",

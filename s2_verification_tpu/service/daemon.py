@@ -236,6 +236,13 @@ class VerifydConfig:
     #: time gate, the `watch` op's data source); <= 0 disables heartbeats
     #: entirely — engines then run exactly the pre-progress code path
     progress_interval_s: float = 0.5
+    #: verdict-exact search pruning (``serve --prune``): append
+    #: rank-order, eager-commit and tail-pin rules on every engine that
+    #: carries them (checker/prune.py); never changes a verdict
+    prune: bool = False
+    #: speculative multi-layer frontier expansion depth for device
+    #: escalations (``serve --speculate-depth``); 0 = off
+    speculate_depth: int = 0
     extra: dict = field(default_factory=dict)
 
 
@@ -481,6 +488,8 @@ class Verifyd:
             batch_engine=config.batch_engine,
             prefix_store=self.prefix,
             progress=self.progress,
+            prune=config.prune,
+            speculate_depth=config.speculate_depth,
         )
         self._job_ids = itertools.count(1)
         #: distributed-search partition grants: (search, part) -> epoch.

@@ -36,6 +36,17 @@ Soundness (why partition verdicts merge by union):
   appends) cannot be merged — the coordinator raises
   :class:`DistSearchError` and the router falls back to the plain
   single-node route: honest, never wrong.
+* Search pruning (``serve --prune``, checker/prune.py) composes with
+  partitioning without coordination: partition jobs always carry
+  snapshot cuts, and the frontier engine stands its *order* prunes
+  (append rank gate, tail pin) down while cuts are collecting — a
+  gated path never accepts, but its dead-weight states belong in the
+  promised exact union.  Eager commit stays on because committed ops
+  are state-identity where they commit, so the end-of-segment union is
+  byte-identical either way.  The rank tables themselves are derived
+  from each segment's own encoded history, so re-grants and epoch
+  bumps recompute them deterministically — no pruned precedence ever
+  crosses a partition boundary.
 
 Robustness (the actual point — see the grant ledger in
 ``service/journal.py``):

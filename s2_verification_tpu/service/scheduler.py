@@ -56,15 +56,25 @@ def shape_key(hist: History) -> str:
 
 
 def _cpu_check(
-    hist: History, budget: float | None, profile: bool = False, progress=None
+    hist: History,
+    budget: float | None,
+    profile: bool = False,
+    progress=None,
+    prune: bool = False,
 ) -> tuple[CheckResult, str]:
-    """Native engine when buildable, Python oracle otherwise (cli.py)."""
+    """Native engine when buildable, Python oracle otherwise (cli.py).
+    ``prune`` hands the native DFS its verdict-exact precedence tables;
+    the oracle fallback ignores it (exhaustive by construction)."""
     from ..checker.native import NativeUnavailable, check_native
 
     try:
         return (
             check_native(
-                hist, time_budget_s=budget, profile=profile, progress=progress
+                hist,
+                time_budget_s=budget,
+                profile=profile,
+                progress=progress,
+                prune=prune,
             ),
             "native",
         )
@@ -73,26 +83,29 @@ def _cpu_check(
         return check(hist, time_budget_s=budget), "oracle"
 
 
-_accepts_progress_cache: tuple = (None, False)
+_accepts_cache: dict[str, tuple] = {}
+
+
+def _accepts_kwarg(fn, name: str) -> bool:
+    """Whether ``fn`` takes a ``name`` kwarg.  Test doubles replace
+    :func:`_cpu_check` with plain ``(hist, budget)`` callables; optional
+    kwargs are only threaded through when the live function can carry
+    them.  The answer is cached per (kwarg, function identity): this runs
+    on every job, and ``inspect.signature`` is tens of microseconds —
+    real money at hundreds of jobs/s."""
+    cached = _accepts_cache.get(name)
+    if cached is not None and cached[0] is fn:
+        return cached[1]
+    try:
+        ok = name in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        ok = False
+    _accepts_cache[name] = (fn, ok)
+    return ok
 
 
 def _accepts_progress(fn) -> bool:
-    """Whether ``fn`` takes a ``progress`` kwarg.  Test doubles replace
-    :func:`_cpu_check` with plain ``(hist, budget)`` callables; the sink
-    is only threaded through when the live function can carry it.  The
-    answer is cached per function identity: this runs on every job, and
-    ``inspect.signature`` is tens of microseconds — real money at
-    hundreds of jobs/s."""
-    global _accepts_progress_cache
-    cached_fn, cached = _accepts_progress_cache
-    if cached_fn is fn:
-        return cached
-    try:
-        ok = "progress" in inspect.signature(fn).parameters
-    except (TypeError, ValueError):
-        ok = False
-    _accepts_progress_cache = (fn, ok)
-    return ok
+    return _accepts_kwarg(fn, "progress")
 
 
 def job_profile(res: CheckResult) -> dict:
@@ -115,6 +128,20 @@ def job_profile(res: CheckResult) -> dict:
             out["timeline"] = st.timeline
         if getattr(st, "shards", None):
             out["shards"] = st.shards
+        # Acceleration counters only when the knobs actually fired: a
+        # prune-off job's profile stays byte-identical to before.
+        for f in (
+            "prune_commits",
+            "prune_dead",
+            "prune_ranked",
+            "spec_launches",
+            "spec_layers",
+            "spec_accepts",
+            "spec_rollbacks",
+        ):
+            v = getattr(st, f, 0)
+            if v:
+                out[f] = v
     phases = getattr(res, "profile", None)
     if isinstance(phases, dict):
         out["phases"] = phases
@@ -149,6 +176,8 @@ class Scheduler:
         batch_engine: str = "auto",
         prefix_store=None,
         progress=None,
+        prune: bool = False,
+        speculate_depth: int = 0,
     ) -> None:
         if device not in ("supervised", "inline", "off"):
             raise ValueError(f"unknown device escalation mode {device!r}")
@@ -196,6 +225,13 @@ class Scheduler:
         #: per-job progress table (service/progress.JobProgress); None
         #: disables heartbeats — every job then runs exactly as before
         self.progress = progress
+        #: verdict-exact search pruning (checker/prune.py): the append
+        #: rank order, eager commit and tail-pin rules on every engine
+        #: that supports them.  Never changes a verdict.
+        self.prune = prune
+        #: speculative multi-layer expansion depth for device escalations
+        #: (0 = off); internally disabled for witness-carrying runs
+        self.speculate_depth = speculate_depth
         self._batcher = None
         if batching:
             from .batcher import Batcher
@@ -546,6 +582,33 @@ class Scheduler:
         if shards:
             done_fields["shards"] = shards
         self.stats.emit("done", **done_fields)
+        st = getattr(res, "stats", None)
+        if st is not None:
+            commits = int(getattr(st, "prune_commits", 0) or 0)
+            dead = int(getattr(st, "prune_dead", 0) or 0)
+            ranked = int(getattr(st, "prune_ranked", 0) or 0)
+            if commits or dead or ranked:
+                self.stats.emit(
+                    "prune_applied",
+                    job=job.id,
+                    backend=backend,
+                    commits=commits,
+                    dead=dead,
+                    ranked=ranked,
+                    trace_id=job.trace_id,
+                )
+            rollbacks = int(getattr(st, "spec_rollbacks", 0) or 0)
+            if rollbacks:
+                self.stats.emit(
+                    "speculation_rollback",
+                    job=job.id,
+                    backend=backend,
+                    rollbacks=rollbacks,
+                    layers=int(getattr(st, "spec_layers", 0) or 0),
+                    launches=int(getattr(st, "spec_launches", 0) or 0),
+                    accepts=int(getattr(st, "spec_accepts", 0) or 0),
+                    trace_id=job.trace_id,
+                )
         out = dict(payload)
         out.update(job=job.id, queue_wait_s=round(queue_wait, 4))
         return ok(out)
@@ -647,6 +710,11 @@ class Scheduler:
                 complete_cuts=bool(plan.snap_keys),
                 time_budget_s=budget,
                 progress=job.progress_sink,
+                # Order prunes (rank gate, tail pin) stand down while
+                # cuts are collecting (checker/frontier.py), so the
+                # partition's end-of-segment union stays exact; eager
+                # commit is union-identical and stays on.
+                prune=self.prune,
             )
         else:
             res = check_frontier_auto(
@@ -659,6 +727,7 @@ class Scheduler:
                 snapshot_cuts=sorted(plan.snap_keys) or None,
                 time_budget_s=budget,
                 progress=job.progress_sink,
+                prune=self.prune,
             )
         self.tracer.add_span(
             f"search.{mode}",
@@ -741,6 +810,8 @@ class Scheduler:
             kw["profile"] = True
         if job.progress_sink is not None and _accepts_progress(_cpu_check):
             kw["progress"] = job.progress_sink
+        if self.prune and _accepts_kwarg(_cpu_check, "prune"):
+            kw["prune"] = True
         res, engine = _cpu_check(job.hist, budget, **kw)
         self.tracer.add_span(
             f"cpu[{engine}]",
@@ -872,6 +943,10 @@ class Scheduler:
                     kw["profile"] = True
                 if job.progress_sink is not None:
                     kw["progress"] = job.progress_sink
+                if self.prune:
+                    kw["prune"] = True
+                if self.speculate_depth:
+                    kw["speculate_depth"] = self.speculate_depth
                 if lease is not None:
                     import jax
 
@@ -900,6 +975,8 @@ class Scheduler:
                 cancel=job.cancel.check,
                 grace_s=self.cancel_grace_s,
                 progress=job.progress_sink,
+                prune=self.prune,
+                speculate_depth=self.speculate_depth,
             )
             if (
                 dres is None

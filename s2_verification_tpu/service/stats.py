@@ -48,6 +48,14 @@ frontier; carries the store's ``entries``/``bytes`` after the put),
 ``reason`` = open_ops / unknown_frontier), and ``window_done`` (one
 ``follow`` window answered: ``stream``, ``window`` ordinal,
 ``verdict``, ``advanced``, cumulative ``ops_total``).
+Search acceleration (ISSUE 19): ``prune_applied`` — verdict-exact
+order pruning contributed to a decided job (``commits`` eager-closed
+ops, ``dead`` tail-pinned configurations, ``ranked`` rank-gated
+candidates), and ``speculation_rollback`` — one or more speculative
+multi-layer dives were discarded on misprediction (``rollbacks``,
+cumulative speculated ``layers``, ``launches``, ``accepts``); both ride
+the verdict-exact guarantee, so they are rate signals, never
+correctness ones.
 ``shape_warm`` marks a job whose
 padded search shape was already run by this daemon — the observable for
 "jitted executables reused instead of recompiled".
@@ -160,6 +168,8 @@ class ServiceStats:
             "partitions_done": 0,
             "epoch_fences": 0,
             "search_progress": 0,
+            "prune_applied": 0,
+            "speculation_rollbacks": 0,
         }
         self._wall_total_s = 0.0
         self._active = 0  # jobs handed to a worker, not yet answered
@@ -447,6 +457,31 @@ class ServiceStats:
             "EWMA search layers per second (last heartbeat), by engine",
             labelnames=("engine",),
         )
+        # Search acceleration (ISSUE 19): verdict-exact pruning and
+        # speculative expansion counters, fed per decided job from the
+        # scheduler's prune_applied / speculation_rollback events.
+        self._m_prune_commits = r.counter(
+            "verifyd_search_prune_commits_total",
+            "Ops eagerly committed by the verdict-exact prune "
+            "(inert ops and state-passing filters closed without search)",
+        )
+        self._m_prune_dead = r.counter(
+            "verifyd_search_prune_dead_total",
+            "Configurations dropped by the tail-pin dead-row rule",
+        )
+        self._m_prune_ranked = r.counter(
+            "verifyd_search_prune_ranked_total",
+            "Expansion candidates skipped by the append rank-order gate",
+        )
+        self._m_spec_layers = r.counter(
+            "verifyd_search_spec_layers_total",
+            "Search layers expanded inside speculative multi-layer dives",
+        )
+        self._m_spec_rollbacks = r.counter(
+            "verifyd_search_spec_rollbacks_total",
+            "Speculative dives discarded on misprediction (exact loop "
+            "re-searches from the pre-dive frontier)",
+        )
 
     # -- event stream -------------------------------------------------------
 
@@ -703,6 +738,16 @@ class ServiceStats:
             if op not in ("grant", "delta", "delta_reply", "done"):
                 op = "other"
             self._m_ds_fences.inc(op=op)
+        elif event == "prune_applied":
+            self._counters["prune_applied"] += 1
+            self._m_prune_commits.inc(int(fields.get("commits", 0)))
+            self._m_prune_dead.inc(int(fields.get("dead", 0)))
+            self._m_prune_ranked.inc(int(fields.get("ranked", 0)))
+        elif event == "speculation_rollback":
+            n = int(fields.get("rollbacks", 1))
+            self._counters["speculation_rollbacks"] += n
+            self._m_spec_rollbacks.inc(n)
+            self._m_spec_layers.inc(int(fields.get("layers", 0)))
         elif event == "search_progress":
             self._counters["search_progress"] += 1
             engine = str(fields.get("engine", "other"))

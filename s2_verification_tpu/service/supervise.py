@@ -98,6 +98,8 @@ def supervised_device_check(
     cancel=None,
     grace_s: float = 5.0,
     progress=None,
+    prune: bool = False,
+    speculate_depth: int = 0,
 ) -> CheckResult | None:
     """Run the device search for ``events`` under supervision.
 
@@ -134,6 +136,11 @@ def supervised_device_check(
     the job's sink — so a supervised search is as watchable as an inline
     one, and the spooled file survives a SIGKILL for the flight
     recorder's post-mortem.
+
+    ``prune``/``speculate_depth`` are the search-accelerator knobs
+    (``checker/device.check_device_auto``): verdict-exact order pruning
+    and the speculative multi-layer dive.  They ride to the child as
+    argv extras, so a restarted attempt keeps the same configuration.
     """
     from ..checker.resilient import default_probe_cmd, drive
     from ..obs.trace import NULL_TRACER
@@ -163,6 +170,10 @@ def supervised_device_check(
         cmd.append("devices=" + ",".join(str(int(i)) for i in devices))
     if profile:
         cmd.append("profile=1")
+    if prune:
+        cmd.append("prune=1")
+    if speculate_depth:
+        cmd.append(f"spec={int(speculate_depth)}")
     if trace_id:
         # Distributed-trace propagation: the child runs its own Tracer
         # under this id and ships its span ring back in the result JSON.
@@ -240,11 +251,17 @@ def _child_main(argv: list[str]) -> int:
     profile = False
     trace_id = ""
     progress_path = ""
+    prune = False
+    spec_depth = 0
     for extra in argv[3:]:
         if extra.startswith("devices="):
             devices = [int(s) for s in extra[len("devices=") :].split(",") if s]
         elif extra.startswith("profile="):
             profile = extra[len("profile=") :] == "1"
+        elif extra.startswith("prune="):
+            prune = extra[len("prune=") :] == "1"
+        elif extra.startswith("spec="):
+            spec_depth = int(extra[len("spec=") :])
         elif extra.startswith("trace="):
             trace_id = extra[len("trace=") :]
         elif extra.startswith("progress="):
@@ -281,6 +298,10 @@ def _child_main(argv: list[str]) -> int:
     kw: dict = {} if device_rows is None else {"device_rows_cap": device_rows}
     if profile:
         kw["profile"] = True
+    if prune:
+        kw["prune"] = True
+    if spec_depth:
+        kw["speculate_depth"] = spec_depth
     if progress_path:
         # The latest heartbeat overwrites the spool file atomically: the
         # parent samples it from its cancel poll, and whatever survives a

@@ -1,0 +1,252 @@
+"""Search-pruning gate: verdict parity + the measured speedup claim.
+
+The pruning/speculation accelerator (checker/prune.py + the device
+search's speculative dive) is only admissible because it is
+**verdict-exact** — same OK, same ILLEGAL, same UNKNOWN as the
+un-pruned engines on every history.  This gate (`make prune`, part of
+`chaos-full`) proves both halves:
+
+1. **Parity matrix** — every entry of the builtin campaign matrix
+   (collector/campaign.py: 5 legal fault shapes + all 4 ground-truth
+   violation classes, seeded and replayable) through five engines:
+
+   - the un-pruned CPU referee (native C++, oracle fallback),
+   - the un-pruned host frontier search,
+   - the pruned host frontier search,
+   - the pruned native DFS,
+   - the pruned + speculative device search (``speculate_depth=3``).
+
+   Every engine must agree with the referee outcome, and conclusive
+   verdicts must match the campaign's ground-truth label.
+
+2. **Speedup gate** — the bench's adversarial north-star config
+   (adversarial k=10, batch=100, seed=0; ``beam=False witness=False``,
+   the exact `bench.py` kw): the pruned + speculative device wall must
+   beat the un-pruned wall by at least ``--ratio`` (default 1.3, the
+   ISSUE acceptance floor; measured ~4.7x on host cores), with nonzero
+   prune/speculation counters proving the fast path actually fired —
+   a silently-neutralized prune must fail the gate, not pass it.
+
+Exit 0 when every assertion holds; 1 with the failures on stderr.
+One JSON summary line lands on stdout.
+
+Usage:
+    python scripts/prune_check.py [--ratio 1.3] [--k 10] [--spec-depth 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier_auto
+from s2_verification_tpu.checker.native import (
+    NativeUnavailable,
+    check_native,
+)
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.collector.campaign import (
+    VIOLATION_CLASSES,
+    builtin_campaigns,
+    collect_labeled,
+)
+
+_LABEL_OUTCOME = {"legal": CheckOutcome.OK, "illegal": CheckOutcome.ILLEGAL}
+
+
+def _fail(failures: list, msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    failures.append(msg)
+
+
+def _referee(hist):
+    """Un-pruned CPU ground truth: native when buildable, oracle else."""
+    try:
+        return check_native(hist), "native"
+    except NativeUnavailable:
+        return check(hist), "oracle"
+
+
+def parity_matrix(spec_depth: int, failures: list) -> dict:
+    """Every builtin campaign through the five-engine parity ladder."""
+    from s2_verification_tpu.checker.device import check_device_auto
+
+    campaigns = builtin_campaigns()
+    classes_seen: set[str] = set()
+    rows = []
+    for name in sorted(campaigns):
+        camp = campaigns[name]
+        # seed 11 is the tier-1 replay seed: every builtin violation
+        # campaign provably fires under it (tests/test_campaign.py).
+        events, label = collect_labeled(camp, seed=11)
+        hist = prepare(events)
+        ref, ref_engine = _referee(hist)
+        engines = {
+            "frontier": check_frontier_auto(hist),
+            "frontier-pruned": check_frontier_auto(hist, prune=True),
+            "device-pruned-spec": check_device_auto(
+                hist, prune=True, speculate_depth=spec_depth, witness=False
+            ),
+        }
+        try:
+            engines["native-pruned"] = check_native(hist, prune=True)
+        except NativeUnavailable:
+            pass
+        for ename, res in engines.items():
+            if res.outcome != ref.outcome:
+                _fail(
+                    failures,
+                    f"{name}: {ename} says {res.outcome.name}, "
+                    f"{ref_engine} referee says {ref.outcome.name}",
+                )
+        expect = _LABEL_OUTCOME.get(label.get("expect"))
+        if expect is not None and ref.outcome != expect:
+            _fail(
+                failures,
+                f"{name}: referee {ref.outcome.name} contradicts "
+                f"ground-truth label {label['expect']}",
+            )
+        v = camp.violation_class()
+        if v is not None:
+            classes_seen.add(v)
+        rows.append({"campaign": name, "outcome": ref.outcome.name})
+        print(
+            f"# {name}: {ref.outcome.name} "
+            f"(label {label.get('expect')}, {len(hist.ops)} ops, parity ok)",
+            file=sys.stderr,
+        )
+    missing = set(VIOLATION_CLASSES) - classes_seen
+    if missing:
+        _fail(failures, f"violation classes never exercised: {sorted(missing)}")
+    return {"entries": len(rows), "violation_classes": sorted(classes_seen)}
+
+
+def speedup_gate(
+    k: int, ratio: float, spec_depth: int, failures: list
+) -> dict:
+    """The bench adversarial config, pruned vs un-pruned device wall."""
+    from s2_verification_tpu.checker.device import check_device
+    from s2_verification_tpu.collector.adversarial import adversarial_events
+
+    hist = prepare(adversarial_events(k, batch=100, seed=0))
+    kw = dict(
+        max_frontier=1 << 21,
+        start_frontier=1 << 14,
+        beam=False,
+        witness=False,
+        collect_stats=True,
+    )
+    res = check_device(hist, **kw)  # warm the un-pruned program
+    t0 = time.monotonic()
+    res = check_device(hist, **kw)
+    plain_s = time.monotonic() - t0
+    pkw = dict(kw, prune=True, speculate_depth=spec_depth)
+    pres = check_device(hist, **pkw)  # warm the pruned program
+    t0 = time.monotonic()
+    pres = check_device(hist, **pkw)
+    pruned_s = time.monotonic() - t0
+    if pres.outcome != res.outcome:
+        _fail(
+            failures,
+            f"adversarial k={k}: pruned {pres.outcome.name} vs "
+            f"un-pruned {res.outcome.name}",
+        )
+    st = pres.stats
+    fired = (
+        st.prune_commits + st.prune_dead + st.prune_ranked + st.spec_launches
+    )
+    if not fired:
+        _fail(
+            failures,
+            f"adversarial k={k}: zero prune/speculation counters — the "
+            "fast path never fired",
+        )
+    speedup = plain_s / max(pruned_s, 1e-9)
+    print(
+        f"# adversarial k={k}: un-pruned {plain_s:.2f}s vs pruned "
+        f"{pruned_s:.2f}s = {speedup:.2f}x (need >= {ratio}x); "
+        f"maxF {res.stats.max_frontier} -> {st.max_frontier}, "
+        f"commits={st.prune_commits} dead={st.prune_dead} "
+        f"spec_launches={st.spec_launches} spec_layers={st.spec_layers} "
+        f"rollbacks={st.spec_rollbacks}",
+        file=sys.stderr,
+    )
+    if speedup < ratio:
+        _fail(
+            failures,
+            f"adversarial k={k}: speedup {speedup:.2f}x below the "
+            f"{ratio}x gate",
+        )
+    return {
+        "k": k,
+        "unpruned_wall_s": round(plain_s, 3),
+        "pruned_wall_s": round(pruned_s, 3),
+        "speedup": round(speedup, 2),
+        "prune_commits": int(st.prune_commits),
+        "prune_dead": int(st.prune_dead),
+        "spec_launches": int(st.spec_launches),
+        "spec_layers": int(st.spec_layers),
+        "spec_rollbacks": int(st.spec_rollbacks),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="prune_check.py",
+        description="pruning parity + speedup gate (make prune)",
+    )
+    ap.add_argument(
+        "--ratio",
+        type=float,
+        default=1.3,
+        help="minimum pruned-vs-unpruned device speedup (default 1.3)",
+    )
+    ap.add_argument(
+        "--k",
+        type=int,
+        default=int(os.environ.get("S2VTPU_PRUNE_ADV_K", "10")),
+        help="adversarial instance size for the speedup gate (default 10, "
+        "the bench config; env S2VTPU_PRUNE_ADV_K)",
+    )
+    ap.add_argument(
+        "--spec-depth",
+        type=int,
+        default=3,
+        help="speculative expansion depth for the pruned runs (default 3)",
+    )
+    ap.add_argument(
+        "--skip-speedup",
+        action="store_true",
+        help="parity matrix only (fast CI smoke)",
+    )
+    args = ap.parse_args()
+
+    failures: list[str] = []
+    t0 = time.monotonic()
+    parity = parity_matrix(args.spec_depth, failures)
+    speedup = (
+        None
+        if args.skip_speedup
+        else speedup_gate(args.k, args.ratio, args.spec_depth, failures)
+    )
+    summary = {
+        "gate": "prune",
+        "ok": not failures,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "parity": parity,
+        "speedup": speedup,
+        "failures": failures,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

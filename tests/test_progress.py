@@ -172,6 +172,42 @@ def test_sink_layer_rate_and_lane_attribution():
     assert out[0]["engine"] == "device" and out[0]["lane"] == 3
 
 
+def test_sink_multi_layer_launch_rate_attribution():
+    """A speculative K-layer launch advances ``layer`` by K in one
+    update: the rate must credit the full delta over the interval, not
+    one-layer-per-heartbeat, and a layer-less offer in between must
+    carry the baseline forward instead of resetting it to zero."""
+    clock, out = Clock(), []
+    sink = ProgressSink(out.append, min_interval_s=0.5, time_fn=clock)
+    sink.update(ops_committed=0, total_ops=40, layer=0)  # baseline
+    # One speculative dive covers layers 0 -> 4 in a single launch.
+    clock.tick(2.0)
+    sink.update(ops_committed=4, total_ops=40, layer=4)
+    assert out[-1]["layer_rate"] == pytest.approx(2.0)  # 4 layers / 2 s
+
+    # A layer-less fold (native child, service-side aggregation) between
+    # layer-bearing updates: rate falls back to the ops delta...
+    clock.tick(1.0)
+    sink.update(ops_committed=6, total_ops=40)
+    assert "layer" not in out[-1]
+    assert out[-1]["layer_rate"] == pytest.approx(2.0)  # (6-4) ops / 1 s
+
+    # ...and the NEXT layer-bearing update is measured against the
+    # carried layer baseline (4), not a zero reset: 8-4 layers over 1 s,
+    # not 8 layers over 1 s.
+    clock.tick(1.0)
+    sink.update(ops_committed=8, total_ops=40, layer=8)
+    assert out[-1]["layer"] == 8
+    assert out[-1]["layer_rate"] == pytest.approx(4.0)
+
+    # Regression shape: a dive that finishes K layers inside one
+    # interval then reports on the next boundary still averages to
+    # K / elapsed, never 1 / elapsed.
+    clock.tick(0.5)
+    sink.update(ops_committed=20, total_ops=40, layer=20)
+    assert out[-1]["layer_rate"] == pytest.approx((20 - 8) / 0.5)
+
+
 # -- EWMA / ETA math with an injected clock -----------------------------------
 
 

@@ -1,0 +1,293 @@
+"""Commutativity-pruning unit coverage (checker/prune.py).
+
+Three layers, mirroring the module's own structure:
+
+1. :func:`classify_pair` — the pairwise static facts.  Positives (pairs
+   that need only one explored order): disjoint-range reads, successful
+   appends with distinct out_tails, same-prefix check_tail pairs.
+   Negatives: overlapping reads with conflicting contents, fencing
+   appends (token mutators never commute statically).
+2. :func:`order_mask` — the canonical-order mask is a strict partial
+   order: irreflexive, antisymmetric, transitively closed, and oriented
+   by the monotone-tail axis.
+3. End-to-end parity — a pruned frontier search resumed from a
+   prefix-cut snapshot reaches the same verdict as the cold un-pruned
+   search, on both an OK and an ILLEGAL history (the prune-under-resume
+   composition the incremental-verification engine relies on).
+
+The campaign-scale differential parity lives in scripts/prune_check.py
+(`make prune`); this file covers the static analysis itself.
+"""
+
+import numpy as np
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu.checker.entries import prepare
+from s2_verification_tpu.checker.frontier import check_frontier
+from s2_verification_tpu.checker.oracle import CheckOutcome, check
+from s2_verification_tpu.checker.prune import (
+    CONFLICT,
+    FREE,
+    ORDERED,
+    PIN_INF,
+    RANK_INF,
+    analyze_history,
+    classify_pair,
+    commutes,
+    order_mask,
+)
+
+
+def _ops(h):
+    return prepare(h.events, elide_trivial=False).ops
+
+
+# -- classify_pair: positives -------------------------------------------------
+
+
+def test_disjoint_range_reads_are_ordered():
+    """Two successful reads observing different committed prefixes:
+    monotone tails force the lower observation first."""
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    r1 = h.read_ok(2, tail=1, stream_hash=fold([5]))
+    h.append_ok(1, [6], tail=2)
+    r2 = h.read_ok(2, tail=2, stream_hash=fold([5, 6]))
+    ops = _ops(h)
+    a, b = ops[r1], ops[r2]
+    assert classify_pair(a, b) == ORDERED
+    assert classify_pair(b, a) == ORDERED  # symmetric classification
+    assert commutes(a, b)  # one representative order suffices
+
+
+def test_successful_appends_with_distinct_tails_are_ordered():
+    h = H()
+    a1 = h.append_ok(1, [5], tail=1)
+    a2 = h.append_ok(2, [6], tail=2)
+    ops = _ops(h)
+    assert classify_pair(ops[a1], ops[a2]) == ORDERED
+    assert commutes(ops[a1], ops[a2])
+
+
+def test_same_prefix_check_tails_are_free():
+    """Two check_tail successes at the same tail are identity at the
+    same states: either order reaches identical state sets."""
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    c1 = h.check_tail_ok(2, tail=1)
+    c2 = h.check_tail_ok(3, tail=1)
+    ops = _ops(h)
+    assert classify_pair(ops[c1], ops[c2]) == FREE
+    assert commutes(ops[c1], ops[c2])
+
+
+def test_inert_ops_commute_with_everything():
+    h = H()
+    a = h.append_ok(1, [5], tail=1)
+    d = h.append_definite_fail(2, [9])
+    rf = h.read_fail(3)
+    ops = _ops(h)
+    for j in (d, rf):
+        assert classify_pair(ops[j], ops[a]) == FREE
+        assert classify_pair(ops[a], ops[j]) == FREE
+
+
+# -- classify_pair: negatives -------------------------------------------------
+
+
+def test_overlapping_reads_with_conflicting_contents_do_not_commute():
+    """Same observed range, different contents: no static order helps —
+    the pair must stay CONFLICT so the search keeps both interleavings
+    (and discovers the history is illegal)."""
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    r1 = h.read_ok(2, tail=1, stream_hash=fold([5]))
+    r2 = h.read_ok(3, tail=1, stream_hash=fold([6]))  # impossible contents
+    ops = _ops(h)
+    assert classify_pair(ops[r1], ops[r2]) == CONFLICT
+    assert not commutes(ops[r1], ops[r2])
+
+
+def test_fencing_token_mutators_never_commute_statically():
+    """A pure token-setting append (zero records) moves no tail, so the
+    tail axis pins nothing: its order against other ops is path-dependent
+    and must stay CONFLICT.  (Record-carrying fenced appends ARE still
+    tail-ordered — success pins their position regardless of tokens.)"""
+    h = H()
+    f1 = h.append_ok(1, [], tail=1, set_token=7)  # fence only
+    f2 = h.append_ok(2, [6], tail=2, token=7)
+    ops = _ops(h)
+    assert classify_pair(ops[f1], ops[f2]) == CONFLICT
+    assert not commutes(ops[f1], ops[f2])
+    # And a record-carrying fenced pair is ordered by tails, tokens or not.
+    h2 = H()
+    g1 = h2.append_ok(1, [5], tail=1, set_token=7)
+    g2 = h2.append_ok(2, [6], tail=2, token=7)
+    ops2 = _ops(h2)
+    assert classify_pair(ops2[g1], ops2[g2]) == ORDERED
+
+
+def test_indefinite_appends_conflict_with_appends():
+    h = H()
+    a = h.append_ok(1, [5], tail=1)
+    i = h.append_indefinite_fail(2, [9])
+    ops = _ops(h)
+    assert classify_pair(ops[a], ops[i]) == CONFLICT
+
+
+def test_duplicate_out_tails_are_not_ordered():
+    """Two appends claiming the same out_tail cannot both linearize, and
+    neither order is statically preferable — CONFLICT, and the rank
+    table must exclude the whole duplicate group."""
+    h = H()
+    a1 = h.append_ok(1, [5], tail=1)
+    a2 = h.append_ok(2, [6], tail=1)
+    hist = prepare(h.events, elide_trivial=False)
+    ops = hist.ops
+    assert classify_pair(ops[a1], ops[a2]) == CONFLICT
+    plan = analyze_history(hist)
+    assert a1 not in plan.rank and a2 not in plan.rank
+
+
+# -- order_mask: canonicality -------------------------------------------------
+
+
+def _mixed_history():
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([5]))
+    h.append_ok(1, [6], tail=2)
+    h.check_tail_ok(3, tail=2)
+    h.append_ok(2, [7], tail=3)
+    h.append_definite_fail(3, [9])
+    h.read_ok(1, tail=3, stream_hash=fold([5, 6, 7]))
+    return prepare(h.events, elide_trivial=False)
+
+
+def test_order_mask_is_a_strict_partial_order():
+    hist = _mixed_history()
+    m = order_mask(hist)
+    n = len(hist.ops)
+    assert m.shape == (n, n)
+    assert not m.diagonal().any()  # irreflexive
+    assert not (m & m.T).any()  # antisymmetric
+    # Transitively closed over the static order: i->j->k implies i->k.
+    closure = m.copy()
+    for _ in range(n):
+        closure = closure | (closure @ closure)
+    assert (closure == m).all()
+
+
+def test_order_mask_orients_along_the_tail_axis():
+    """Every ORDERED pair points from the lower tail position to the
+    higher one — the canonical order the rank gate enforces."""
+    hist = _mixed_history()
+    m = order_mask(hist)
+    ops = hist.ops
+    for i in range(len(ops)):
+        for j in range(len(ops)):
+            if m[i, j]:
+                assert classify_pair(ops[i], ops[j]) == ORDERED
+                ti = int(ops[i].out.tail) & 0xFFFFFFFF
+                tj = int(ops[j].out.tail) & 0xFFFFFFFF
+                assert ti <= tj
+    # The three ranked appends form a chain: 1 -> 2 -> 3 on the mask.
+    app = [op.index for op in ops if m[op.index].any() or m[:, op.index].any()]
+    assert app, "mask should be non-trivial on this history"
+
+
+def test_host_plan_summarizes_the_mask():
+    hist = _mixed_history()
+    plan = analyze_history(hist)
+    # Dense ranks over the unique-tail appends, in tail order.
+    ranked = sorted(plan.rank, key=plan.rank.get)
+    tails = [int(hist.ops[j].out.tail) & 0xFFFFFFFF for j in ranked]
+    assert tails == sorted(tails)
+    assert plan.n_ranked == 3
+    # Nothing committed yet: the lowest rank (0) is still remaining, and
+    # the minimum pin is the first append's start position (0).
+    zero = tuple(0 for _ in hist.chains)
+    assert plan.min_remaining_rank(zero) == 0
+    assert plan.min_pin(zero) == 0
+    # Everything committed: both summaries are neutral.
+    full = tuple(len(c) for c in hist.chains)
+    assert plan.min_remaining_rank(full) == int(RANK_INF)
+    assert plan.min_pin(full) == int(PIN_INF)
+
+
+# -- prune under prefix resume ------------------------------------------------
+
+
+def _closed_cut(hist):
+    """An interior prefix-closed op boundary (every op before it returns
+    before every op after it is called)."""
+    ops = hist.ops
+    for k in range(1, len(ops)):
+        if max(op.ret for op in ops[:k]) < min(op.call for op in ops[k:]):
+            return k
+    return None
+
+
+def _legal_history():
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([5]))
+    h.append_ok(1, [6], tail=2)
+    h.append_ok(2, [7], tail=3)
+    h.check_tail_ok(3, tail=3)
+    h.read_ok(1, tail=3, stream_hash=fold([5, 6, 7]))
+    return prepare(h.events, elide_trivial=False)
+
+
+def _illegal_history():
+    h = H()
+    h.append_ok(1, [5], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([5]))
+    h.append_ok(1, [6], tail=2)
+    # Stale read: observes tail 1 after tail 2 was both written and read.
+    h.read_ok(3, tail=2, stream_hash=fold([5, 6]))
+    h.read_ok(2, tail=1, stream_hash=fold([5]))
+    return prepare(h.events, elide_trivial=False)
+
+
+@pytest.mark.parametrize("build", [_legal_history, _illegal_history])
+def test_prune_under_prefix_resume_parity(build):
+    """Snapshot at a closed cut with pruning on, resume with pruning on:
+    the composed verdict must equal the cold un-pruned verdict (and the
+    carried union must equal the un-pruned one — order prunes stand down
+    while cuts collect, eager commit is union-identical)."""
+    hist = build()
+    cold = check_frontier(hist, witness=False)
+    assert cold.outcome == check(hist).outcome  # oracle anchors the test
+    K = _closed_cut(hist)
+    assert K is not None, "test histories must have an interior closed cut"
+
+    plain = check_frontier(
+        hist, witness=False, snapshot_cuts=[K], complete_cuts=True
+    )
+    pruned = check_frontier(
+        hist, witness=False, snapshot_cuts=[K], complete_cuts=True, prune=True
+    )
+    assert pruned.outcome == cold.outcome
+
+    if cold.outcome == CheckOutcome.OK:
+        plain_union = getattr(plain, "snapshots", {}).get(K)
+        pruned_union = getattr(pruned, "snapshots", {}).get(K)
+        assert plain_union is not None and pruned_union is not None
+        assert set(pruned_union) == set(plain_union)
+
+    # Resume path: rebuild counts at the cut and search the suffix with
+    # pruning enabled; verdict must match the cold full-history verdict.
+    union = getattr(pruned, "snapshots", {}).get(K)
+    if union is None:
+        return  # ILLEGAL before the cut completed: nothing to resume
+    counts = tuple(sum(1 for j in chain if j < K) for chain in hist.chains)
+    resumed = check_frontier(
+        hist,
+        witness=False,
+        init_counts=counts,
+        init_states=list(union),
+        prune=True,
+    )
+    assert resumed.outcome == cold.outcome
