@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet distsearch overload soak batch prefix prune perfgate lint clean
+.PHONY: all native test test-fast t1 fuzz bench chaos chaos-full obs mesh fleet distsearch telemetry overload soak batch prefix prune perfgate lint clean
 
 all: native
 
@@ -37,7 +37,7 @@ bench:
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py --quick
 
-chaos-full: lint obs mesh fleet distsearch overload soak batch prefix prune
+chaos-full: lint obs mesh fleet distsearch telemetry overload soak batch prefix prune
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_bench.py
 
 # Observability smoke (scripts/obs_check.py): boot verifyd with
@@ -119,6 +119,16 @@ prune: native
 # rejoin, clean rolling drain.
 fleet:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/fleet_check.py
+
+# Fleet-telemetry gate (scripts/telemetry_check.py): two backends behind
+# the router's FleetScraper — both node labels in /fleet/metrics with
+# bounded cardinality, a SIGKILLed backend reading as a gap (never a
+# crash or zeros), the restarted node resuming its sentinel baseline
+# from the durable tsdb and still firing perf_regression, cold tsq
+# agreeing with the live op, and service_bench with the recorder armed
+# holding >=0.97x the published baseline.
+telemetry:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/telemetry_check.py
 
 # Distributed-search gate (scripts/distsearch_check.py): three subprocess
 # backends behind the router coordinate one job sized past a single

@@ -41,7 +41,7 @@ INFO = "info"
 
 _SEV_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
 
-CACHE_SCHEMA = 3  # bump to invalidate caches when pass logic changes
+CACHE_SCHEMA = 4  # bump to invalidate caches when pass logic changes
 
 _SKIP_DIRS = {"__pycache__", ".git", ".mypy_cache", ".pytest_cache", "node_modules"}
 

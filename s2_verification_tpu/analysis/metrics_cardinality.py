@@ -24,9 +24,25 @@ Rules
 
       (membership test against a literal tuple with a literal fallback
       rebind — the fold used by job_cancelled / admission_shed);
+    - a name validated against a **frozen instance attribute**::
+
+          # __init__: self._nodes = tuple(sorted(targets))
+          if node not in self._nodes:
+              node = "other"
+
+      (the fleet-membership idiom: the attribute must be assigned in the
+      class's ``__init__`` from a ``tuple(...)``/``frozenset(...)`` call,
+      so the value set is fixed at construction — bounded by deployment
+      config like the router's ``--backend`` list, not by traffic);
     - a for-loop variable ranging over a literal tuple/list;
     - ``<MODULE_CONST_DICT>.get(x, "literal")`` where the module-level dict
       has only literal values (the verdict-label table idiom).
+
+    Labels on **info-style gauges** — families ending ``_info``, the
+    Prometheus convention for build/version metadata (one series, value
+    1, identity carried in labels) — are exempt: their labels are
+    inherently open (version strings) but the family is one-series by
+    construction, so there is no cardinality to explode.
 
 ``metric-name`` (error)
     Registered metric families must follow the exposition conventions:
@@ -61,15 +77,47 @@ def _is_literal(node: ast.expr) -> bool:
     return isinstance(node, ast.Constant)
 
 
+def _frozen_attrs(cls: ast.ClassDef) -> set[str]:
+    """Instance attributes assigned in ``__init__`` from a
+    ``tuple(...)``/``frozenset(...)`` call — fixed at construction, so a
+    membership test against them proves a closed value set."""
+    out: set[str] = set()
+    for stmt in cls.body:
+        if not (isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__"):
+            continue
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in ("tuple", "frozenset")
+            ):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.add(t.attr)
+    return out
+
+
 class _FnScope:
     """Per-function facts about local names: literal-only assignment and
     the membership-validation idiom."""
 
-    def __init__(self, fn: ast.AST, mod_consts: dict[str, ast.expr]):
+    def __init__(
+        self,
+        fn: ast.AST,
+        mod_consts: dict[str, ast.expr],
+        frozen_attrs: set[str] | None = None,
+    ):
         self.literal_only: dict[str, bool] = {}
         self.validated: set[str] = set()
         self.loop_literal: set[str] = set()
         self.mod_consts = mod_consts
+        self.frozen_attrs = frozen_attrs or set()
         for node in ast.walk(fn):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
@@ -85,15 +133,29 @@ class _FnScope:
             elif isinstance(node, ast.If):
                 self._scan_validation(node)
 
+    def _closed_container(self, node: ast.expr) -> bool:
+        """Membership-test comparators that prove a closed set: a literal
+        tuple, or a frozen instance attribute (``self._nodes`` assigned in
+        ``__init__`` from ``tuple(...)``/``frozenset(...)``)."""
+        if literal_str_tuple(node) is not None:
+            return True
+        return (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.frozen_attrs
+        )
+
     def _scan_validation(self, node: ast.If) -> None:
-        """``if X not in (<literals>): X = <literal>`` marks X validated."""
+        """``if X not in <closed container>: X = <literal>`` marks X
+        validated (see :meth:`_closed_container` for what qualifies)."""
         test = node.test
         if not (
             isinstance(test, ast.Compare)
             and len(test.ops) == 1
             and isinstance(test.ops[0], ast.NotIn)
             and isinstance(test.left, ast.Name)
-            and literal_str_tuple(test.comparators[0]) is not None
+            and self._closed_container(test.comparators[0])
         ):
             return
         var = test.left.id
@@ -205,14 +267,48 @@ class MetricsCardinalityPass(FilePass):
                     )
                 )
 
-        # label closedness, per enclosing function
+        # receivers bound to *_info families: labels exempt (one-series
+        # identity metrics — the Prometheus info-gauge convention)
+        info_receivers: set[str] = set()
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+            ):
+                continue
+            call = node.value
+            if not (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr in _REG_METHODS
+                and call.args
+            ):
+                continue
+            fam = const_str(call.args[0])
+            if fam is None or not fam.endswith("_info"):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    info_receivers.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    info_receivers.add(t.id)
+
+        # label closedness, per enclosing function (class-aware: frozen
+        # instance attributes are closed membership containers)
         scopes: dict[int, _FnScope] = {}
+        class_attrs: dict[int, set[str]] = {}
 
         def scope_for(parents: list[ast.AST]) -> _FnScope | None:
+            frozen: set[str] = set()
+            for p in reversed(parents):
+                if isinstance(p, ast.ClassDef):
+                    if id(p) not in class_attrs:
+                        class_attrs[id(p)] = _frozen_attrs(p)
+                    frozen = class_attrs[id(p)]
+                    break
             for p in reversed(parents):
                 if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     if id(p) not in scopes:
-                        scopes[id(p)] = _FnScope(p, mod_consts)
+                        scopes[id(p)] = _FnScope(p, mod_consts, frozen)
                     return scopes[id(p)]
             return None
 
@@ -228,6 +324,23 @@ class MetricsCardinalityPass(FilePass):
                 and node.keywords
                 and _looks_like_metric_receiver(node.func.value)
             ):
+                continue
+            recv = node.func.value
+            if isinstance(recv, ast.Call):
+                fn = recv.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in _REG_METHODS
+                    and recv.args
+                    and (const_str(recv.args[0]) or "").endswith("_info")
+                ):
+                    continue  # inline-registered info gauge
+            recv_name = (
+                recv.attr
+                if isinstance(recv, ast.Attribute)
+                else recv.id if isinstance(recv, ast.Name) else None
+            )
+            if recv_name is not None and recv_name in info_receivers:
                 continue
             scope = scope_for(parents)
             for kw in node.keywords:

@@ -661,6 +661,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         resource_sample_s=args.resource_sample,
         retrace_storm_threshold=args.retrace_storm,
         dashboard_sample_s=args.dashboard_sample,
+        telemetry_dir=args.telemetry_dir,
+        telemetry_sample_s=args.telemetry_sample,
         max_rss_frac=args.max_rss_frac,
         deadline_grace_s=args.deadline_grace,
         quarantine_threshold=args.quarantine_threshold,
@@ -762,6 +764,9 @@ def _cmd_route_serve(args: argparse.Namespace) -> int:
             distsearch_segments=args.distsearch_segments,
             distsearch_straggler_s=args.distsearch_straggler,
             distsearch_max_regrants=args.distsearch_max_regrants,
+            scrape_interval_s=args.scrape_interval,
+            telemetry_dir=args.telemetry_dir,
+            telemetry_sample_s=args.telemetry_sample,
         )
         router = VerifydRouter(cfg)
     except ValueError as e:
@@ -866,9 +871,19 @@ def _cmd_route_fleet(args: argparse.Namespace) -> int:
             flags.append(f"breaker={b.get('breaker')}")
         if b.get("last_error"):
             flags.append(f"last_error={b['last_error']}")
+        build = b.get("build") or {}
+        build_str = ""
+        if build:
+            # The scraper captured verifyd_build_info labels off this node.
+            build_str = (
+                f"  build=v{build.get('version', '?')}"
+                f"/{build.get('backend', '?')}"
+                f"/py{build.get('python', '?')}"
+            )
         print(
             f"  {b.get('name')}: {up}  addr={b.get('address')}  "
             f"in_flight={b.get('in_flight')}"
+            + build_str
             + (f"  [{', '.join(flags)}]" if flags else "")
         )
     return 0
@@ -891,6 +906,148 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     # Exit codes mirror the verdict: 0 clean shutdown, 1 unclean death —
     # scriptable ("did the last run die?") without parsing the report.
     return 0 if pm["clean_shutdown"] else 1
+
+
+def _cmd_tsq(args: argparse.Namespace) -> int:
+    """Query telemetry history: live off a daemon/router (--socket, the
+    ``tsq`` op) or cold off a telemetry directory — same store, same
+    answer, the daemon doesn't even have to be alive."""
+    import json as _json
+
+    labels: dict[str, str] = {}
+    for spec in args.label or []:
+        key, sep, val = spec.partition("=")
+        if not sep or not key:
+            log.error("bad --label %r: expected KEY=VALUE", spec)
+            return USAGE_EXIT
+        labels[key] = val
+
+    if args.socket:
+        from .service.client import (
+            VerifydClient,
+            VerifydError,
+            VerifydUnavailable,
+        )
+        from .service.protocol import EXIT_PROTOCOL, EXIT_UNAVAILABLE
+
+        try:
+            client = VerifydClient(args.socket, secret=_read_secret(args))
+            reply = client.tsq(
+                res=args.res,
+                metric=args.metric or None,
+                labels=labels or None,
+                since=args.since,
+                until=args.until,
+                limit=args.limit,
+                info=args.info,
+            )
+        except ValueError as e:
+            log.error("%s", e)
+            return USAGE_EXIT
+        except VerifydUnavailable as e:
+            log.error("cannot reach verifyd on %s: %s", args.socket, e.msg)
+            return EXIT_UNAVAILABLE
+        except VerifydError as e:
+            log.error("tsq refused: %s", e)
+            return EXIT_PROTOCOL
+    else:
+        from .obs.tsdb import default_dir, query, telemetry_info
+
+        tdir = args.telemetry_dir or (
+            default_dir(args.state_dir) if args.state_dir else None
+        )
+        if not tdir:
+            log.error(
+                "tsq needs --socket (live) or --telemetry-dir / "
+                "--state-dir (cold)"
+            )
+            return USAGE_EXIT
+        if not os.path.isdir(tdir):
+            log.error("telemetry dir %s does not exist", tdir)
+            return USAGE_EXIT
+        if args.info:
+            reply = telemetry_info(tdir)
+        else:
+            reply = query(
+                tdir,
+                res=args.res,
+                metric=args.metric or None,
+                labels=labels or None,
+                since=args.since,
+                until=args.until,
+                limit=args.limit,
+            )
+
+    if args.json:
+        print(_json.dumps(reply, sort_keys=True), flush=True)
+        return 0
+
+    if args.info:
+        print(f"telemetry store: {reply.get('dir', args.socket)}")
+        for res, info in sorted((reply.get("resolutions") or {}).items()):
+            rec = info.get("recovery") or {}
+            print(
+                f"  {res:<3s} {info.get('records', 0):>6} record(s) "
+                f"{info.get('series', 0):>4} series "
+                f"{info.get('bytes', 0):>9}B  "
+                f"torn tail {rec.get('torn_tail_bytes', 0)}B, "
+                f"{rec.get('bad_segments', 0)} bad segment(s)"
+            )
+        return 0
+
+    series = reply.get("series") or {}
+    if args.rate:
+        # Cumulative counters → per-second rates.  Counters reset to 0
+        # at every daemon boot, so a negative delta marks a restart, not
+        # a decrease — clamp it to 0 instead of plotting nonsense.
+        for key, pts in series.items():
+            rated = []
+            for (t0, v0), (t1, v1) in zip(pts, pts[1:]):
+                dt = t1 - t0
+                if dt > 0:
+                    rated.append([t1, max(0.0, (v1 - v0) / dt)])
+            series[key] = rated
+
+    if args.csv:
+        import csv as _csv
+
+        w = _csv.writer(sys.stdout)
+        w.writerow(["series", "t", "value"])
+        for key in sorted(series):
+            for t, v in series[key]:
+                w.writerow([key, t, v])
+        return 0
+
+    rng = reply.get("range") or [None, None]
+    span = (
+        f"{_fmt_wall(rng[0])} .. {_fmt_wall(rng[1])}"
+        if rng[0] is not None
+        else "(empty)"
+    )
+    print(
+        f"res={reply.get('res')}  {len(series)} series, "
+        f"{reply.get('points', 0)} point(s)  {span}"
+        + ("  [rate/s]" if args.rate else "")
+    )
+    for key in sorted(series):
+        vals = [p[1] for p in series[key]]
+        if not vals:
+            continue
+        print(
+            f"  {_spark(vals, args.width)}  "
+            f"n={len(vals):<4d} min={min(vals):<10.6g} "
+            f"max={max(vals):<10.6g} last={vals[-1]:<10.6g} {key}"
+        )
+    if not series:
+        print("  no matching series")
+    return 0
+
+
+def _fmt_wall(t) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(t)))
+    except (TypeError, ValueError, OverflowError):
+        return "?"
 
 
 def _print_quarantine_entries(entries: list, threshold) -> None:
@@ -2362,6 +2519,23 @@ def build_parser() -> argparse.ArgumentParser:
         "the dashboard)",
     )
     s.add_argument(
+        "--telemetry-dir",
+        default=None,
+        metavar="DIR",
+        help="durable telemetry store root (delta-encoded registry "
+        "snapshots at raw/1m/15m resolutions; the tsq command and "
+        "sentinel re-seeding read it); default <state-dir>/telemetry, "
+        "disabled without a state dir",
+    )
+    s.add_argument(
+        "--telemetry-sample",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="telemetry sampling interval for the raw ring (the 1m/15m "
+        "rings downsample from it; default 2.0; <=0 disables recording)",
+    )
+    s.add_argument(
         "--drain-timeout",
         type=float,
         default=0.0,
@@ -2664,6 +2838,31 @@ def build_parser() -> argparse.ArgumentParser:
         "inconclusive owner) before the merged verdict degrades to "
         "UNKNOWN (default 3)",
     )
+    rs.add_argument(
+        "--scrape-interval",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="fleet-metrics scrape period: every backend's families "
+        "polled and merged under a node label onto /fleet/metrics and "
+        "the fleet dashboard (default 2.0; <=0 disables the scraper)",
+    )
+    rs.add_argument(
+        "--telemetry-dir",
+        default=None,
+        metavar="DIR",
+        help="durable telemetry store root for the router's registry "
+        "(which carries the merged per-node fleet gauges); default "
+        "<state-dir>/telemetry, disabled without a state dir",
+    )
+    rs.add_argument(
+        "--telemetry-sample",
+        type=float,
+        default=2.0,
+        metavar="SECONDS",
+        help="telemetry sampling interval for the raw ring (default "
+        "2.0; <=0 disables recording)",
+    )
     rs.set_defaults(fn=_cmd_route_serve)
 
     def _route_op_parser(name: str, help_text: str):
@@ -2736,6 +2935,107 @@ def build_parser() -> argparse.ArgumentParser:
         help="emit the full post-mortem as JSON instead of the report",
     )
     d.set_defaults(fn=_cmd_doctor)
+
+    tq = sub.add_parser(
+        "tsq",
+        help="query durable telemetry history: per-series points with "
+        "sparklines, live off a daemon/router socket or cold off a "
+        "telemetry directory (same store, same answer)",
+    )
+    tq.add_argument(
+        "-socket",
+        "--socket",
+        default=None,
+        help="live path: a running daemon/router (unix-socket path, or "
+        "HOST:PORT with --secret-file / VERIFYD_SECRET)",
+    )
+    tq.add_argument(
+        "--secret-file",
+        default=None,
+        help="shared-secret file for a TCP --socket",
+    )
+    tq.add_argument(
+        "--telemetry-dir",
+        default=None,
+        metavar="DIR",
+        help="cold path: read the rings straight from a telemetry dir "
+        "(works while the daemon is dead)",
+    )
+    tq.add_argument(
+        "--state-dir",
+        default=None,
+        metavar="DIR",
+        help="cold-path shorthand: DIR/telemetry",
+    )
+    tq.add_argument(
+        "--res",
+        choices=("raw", "1m", "15m"),
+        default="raw",
+        help="resolution ring to read (default raw)",
+    )
+    tq.add_argument(
+        "--metric",
+        default=None,
+        metavar="SUBSTR",
+        help="series-name substring filter (e.g. queue_depth)",
+    )
+    tq.add_argument(
+        "--label",
+        action="append",
+        default=None,
+        metavar="KEY=VALUE",
+        help="label equality filter (repeatable; all must match)",
+    )
+    tq.add_argument(
+        "--since",
+        type=float,
+        default=None,
+        metavar="EPOCH",
+        help="range start (unix seconds)",
+    )
+    tq.add_argument(
+        "--until",
+        type=float,
+        default=None,
+        metavar="EPOCH",
+        help="range end (unix seconds)",
+    )
+    tq.add_argument(
+        "--limit",
+        type=int,
+        default=None,
+        metavar="N",
+        help="points kept per series, from the tail (default 360 live, "
+        "720 cold)",
+    )
+    tq.add_argument(
+        "--rate",
+        action="store_true",
+        help="render cumulative counters as per-second rates (negative "
+        "deltas from restarts clamp to 0)",
+    )
+    tq.add_argument(
+        "--info",
+        action="store_true",
+        help="ring inventory (records, series, bytes, recovery) instead "
+        "of points",
+    )
+    tq.add_argument(
+        "--width",
+        type=int,
+        default=48,
+        metavar="COLS",
+        help="sparkline width (default 48)",
+    )
+    tq.add_argument(
+        "--json", action="store_true", help="emit the raw reply JSON"
+    )
+    tq.add_argument(
+        "--csv",
+        action="store_true",
+        help="emit series,t,value rows instead of the sparkline table",
+    )
+    tq.set_defaults(fn=_cmd_tsq)
 
     qp = sub.add_parser(
         "quarantine",

@@ -35,6 +35,14 @@ engines and verifyd hot paths:
 - ``dashboard`` — live self-contained HTML dashboard (``/dashboard`` on
                 the obs httpd): sparkline history sampled straight from
                 the metric families.
+- ``tsdb``    — durable multi-resolution time-series store over seglog
+                (delta-encoded registry snapshots, byte-bounded
+                retention, cold reader): telemetry that survives
+                restarts, seeds sentinel baselines, and answers ``tsq``.
+- ``federate``— the router's FleetScraper: every backend's metrics
+                merged under a closed ``node`` label into
+                ``/fleet/metrics``, a fleet SLO rollup, and the fleet
+                dashboard board.
 
 Everything here is stdlib-only by design: the daemon must stay deployable
 on a bare TPU host image with no pip access.
@@ -44,6 +52,7 @@ from .alerts import AlertEngine, AlertRule, builtin_rules, parse_rule
 from .archive import ProfileArchive, filter_records, read_archive, read_corpus
 from .context import new_trace_id, valid_trace_id
 from .dashboard import Dashboard
+from .federate import FleetScraper, ScrapeTarget
 from .flight import FlightRecorder, postmortem, read_flight, render_postmortem
 from .health import SLOConfig, SLOHealth
 from .introspect import (
@@ -56,14 +65,16 @@ from .introspect import (
 )
 from .log import StructuredLogger
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
-from .sentinel import PerfSentinel, SentinelConfig
+from .sentinel import PerfSentinel, SentinelConfig, seed_from_telemetry
 from .trace import Tracer
+from .tsdb import TelemetryStore, last_values, query, telemetry_info
 
 __all__ = [
     "AlertEngine",
     "AlertRule",
     "Counter",
     "Dashboard",
+    "FleetScraper",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -75,20 +86,26 @@ __all__ = [
     "ResourceSampler",
     "SLOConfig",
     "SLOHealth",
+    "ScrapeTarget",
     "SentinelConfig",
     "StructuredLogger",
+    "TelemetryStore",
     "Tracer",
     "builtin_rules",
     "filter_records",
     "get_job_context",
     "job_context",
+    "last_values",
     "new_trace_id",
     "observe_jit",
     "parse_rule",
     "postmortem",
+    "query",
     "read_archive",
     "read_corpus",
     "read_flight",
     "render_postmortem",
+    "seed_from_telemetry",
+    "telemetry_info",
     "valid_trace_id",
 ]

@@ -256,6 +256,47 @@ def postmortem(
 
     distsearch = read_grants_cold(state_dir)
 
+    # Telemetry history (obs/tsdb.py): cold read of the durable metric
+    # rings under <state_dir>/telemetry — the trajectory of the load
+    # picture in the daemon's final stretch, plus the sentinel baselines
+    # the *next* boot will seed from.  Pre-telemetry state dirs simply
+    # have no rings.
+    from .tsdb import default_dir as _telemetry_default_dir
+    from .tsdb import last_values as _telemetry_last
+    from .tsdb import query as _telemetry_query
+    from .tsdb import telemetry_info as _telemetry_info
+
+    telemetry: Optional[Dict[str, Any]] = None
+    tdir = _telemetry_default_dir(state_dir)
+    if os.path.isdir(tdir):
+        info = _telemetry_info(tdir)
+        tel_last_t, finals = _telemetry_last(tdir)
+        kept = {
+            key: val
+            for key, val in finals.items()
+            if key.startswith(
+                (
+                    "verifyd_jobs_completed_total",
+                    "verifyd_queue_depth",
+                    "verifyd_resource_rss_bytes",
+                    "verifyd_perf_baseline_wall_seconds",
+                    "verifyd_perf_regression_fired",
+                    "verifyd_slo_healthy",
+                )
+            )
+        }
+        trajectories: Dict[str, Any] = {}
+        for metric in ("verifyd_queue_depth", "verifyd_resource_rss_bytes"):
+            q = _telemetry_query(tdir, metric=metric, limit=tail)
+            trajectories.update(q["series"])
+        telemetry = {
+            "dir": tdir,
+            "resolutions": info["resolutions"],
+            "last_t": tel_last_t,
+            "final_values": kept,
+            "trajectories": trajectories,
+        }
+
     prefix_activity: Dict[str, int] = {}
     for ev in events:
         name = ev.get("ev") or ev.get("event")
@@ -326,6 +367,7 @@ def postmortem(
         "slo_at_death": slo_at_death,
         "prefix_store": prefix_store,
         "prefix_activity": prefix_activity,
+        "telemetry": telemetry,
         "search_progress": search_progress,
         "distsearch": distsearch,
         # Resource timeline before death: keep the tail — the interesting
@@ -668,6 +710,57 @@ def render_postmortem(pm: Dict[str, Any], *, tail: int = 20) -> str:
                         d.get("bytes"),
                     )
                 )
+
+    tel = pm.get("telemetry")
+    if tel is not None:
+        add("")
+        add("-- telemetry history: %s --" % tel.get("dir"))
+        for res, info in sorted((tel.get("resolutions") or {}).items()):
+            rec = info.get("recovery") or {}
+            add(
+                "  %-3s %6s record(s) %4s series %9sB  last %s  "
+                "torn tail %sB, %s bad segment(s)"
+                % (
+                    res,
+                    info.get("records", 0),
+                    info.get("series", 0),
+                    info.get("bytes", 0),
+                    _fmt_t(info.get("last_t")),
+                    rec.get("torn_tail_bytes", "?"),
+                    rec.get("bad_segments", "?"),
+                )
+            )
+        finals = tel.get("final_values") or {}
+        baselines = sorted(
+            k
+            for k in finals
+            if k.startswith("verifyd_perf_baseline_wall_seconds")
+        )
+        if baselines:
+            add("  sentinel baselines at death (the next boot seeds these):")
+            for k in baselines[:10]:
+                fired_key = k.replace(
+                    "verifyd_perf_baseline_wall_seconds",
+                    "verifyd_perf_regression_fired",
+                )
+                add(
+                    "    %s = %.4fs%s"
+                    % (
+                        k,
+                        finals[k],
+                        "  LATCHED"
+                        if finals.get(fired_key, 0.0) >= 0.5
+                        else "",
+                    )
+                )
+        for key, pts in sorted((tel.get("trajectories") or {}).items()):
+            if not pts:
+                continue
+            vals = [p[1] for p in pts]
+            add(
+                "  %s: last %d point(s)  min=%.1f max=%.1f final=%.1f"
+                % (key, len(pts), min(vals), max(vals), vals[-1])
+            )
 
     if pm.get("resources"):
         add("")

@@ -24,6 +24,11 @@ Endpoints:
 - ``GET /dashboard`` — a self-contained zero-dependency HTML page with
   server-side SVG sparklines over the retained scrape ring (404 without
   a dashboard); ``GET /dashboard.json`` is the raw series feed.
+- ``GET /fleet/metrics`` / ``/fleet/slo`` / ``/fleet/dashboard`` /
+  ``/fleet/dashboard.json`` — the federated fleet plane (404 without a
+  federator; only the router attaches one): every backend's families
+  merged under a ``node`` label, the fleet SLO rollup, and the fleet
+  board.
 """
 
 from __future__ import annotations
@@ -37,6 +42,7 @@ from .metrics import OPENMETRICS_CONTENT_TYPE, MetricsRegistry
 
 if TYPE_CHECKING:  # pragma: no cover - import cycle guard (health ← metrics)
     from .dashboard import Dashboard
+    from .federate import FleetScraper
     from .health import SLOHealth
     from .sentinel import PerfSentinel
 
@@ -59,11 +65,13 @@ class MetricsServer:
         health: "Optional[SLOHealth]" = None,
         sentinel: "Optional[PerfSentinel]" = None,
         dashboard: "Optional[Dashboard]" = None,
+        federator: "Optional[FleetScraper]" = None,
     ) -> None:
         self.registry = registry
         self.health = health
         self.sentinel = sentinel
         self.dashboard = dashboard
+        self.federator = federator
 
         server = self
 
@@ -127,6 +135,36 @@ class MetricsServer:
                     self._reply(
                         200,
                         server.dashboard.render_json().encode("utf-8"),
+                        _JSON_TYPE,
+                    )
+                elif path == "/fleet/metrics" and server.federator is not None:
+                    self._reply(
+                        200,
+                        server.federator.render().encode("utf-8"),
+                        CONTENT_TYPE,
+                    )
+                elif path == "/fleet/slo" and server.federator is not None:
+                    snap = server.federator.slo_rollup()
+                    self._reply(
+                        200,
+                        (json.dumps(snap, sort_keys=True) + "\n").encode("utf-8"),
+                        _JSON_TYPE,
+                    )
+                elif (
+                    path == "/fleet/dashboard" and server.federator is not None
+                ):
+                    self._reply(
+                        200,
+                        server.federator.render_html().encode("utf-8"),
+                        _HTML_TYPE,
+                    )
+                elif (
+                    path == "/fleet/dashboard.json"
+                    and server.federator is not None
+                ):
+                    self._reply(
+                        200,
+                        server.federator.render_json().encode("utf-8"),
                         _JSON_TYPE,
                     )
                 else:

@@ -41,7 +41,7 @@ from typing import Any, Callable, Dict, Optional
 
 from .metrics import MetricsRegistry
 
-__all__ = ["PerfSentinel", "SentinelConfig", "ewma_drift"]
+__all__ = ["PerfSentinel", "SentinelConfig", "ewma_drift", "seed_from_telemetry"]
 
 
 @dataclass(frozen=True)
@@ -106,6 +106,14 @@ class PerfSentinel:
             "Sentinel EWMA wall-time baseline, by shape",
             labelnames=("shape",),
         )
+        # Latch state as a gauge so the telemetry store carries it across
+        # restarts: a shape that was paging when the process died must not
+        # re-page on the first post-boot sample (seed() restores it).
+        self._m_fired = self.registry.gauge(
+            "verifyd_perf_regression_fired",
+            "Sentinel regression latch, by shape (1 = latched)",
+            labelnames=("shape",),
+        )
 
     # -- stream side ---------------------------------------------------------
 
@@ -146,6 +154,8 @@ class PerfSentinel:
             if st.ewma_wall is None:
                 st.ewma_wall = wall_s
                 self._m_baseline.set(st.ewma_wall, shape=shape)
+                # padding-bucketed like the baseline gauge above
+                self._m_fired.set(0.0, shape=shape)  # verifylint: disable=metric-open-label
                 return None
             baseline = st.ewma_wall
             judged = (
@@ -171,6 +181,8 @@ class PerfSentinel:
                 st.fired = False  # recovery re-arms the shape
                 fire = False
             self._m_baseline.set(st.ewma_wall, shape=shape)
+            # padding-bucketed like the baseline gauge above
+            self._m_fired.set(1.0 if st.fired else 0.0, shape=shape)  # verifylint: disable=metric-open-label
             if not fire:
                 return None
             self._m_regressions.inc(shape=shape)
@@ -186,6 +198,40 @@ class PerfSentinel:
             if st.ewma_rate is not None:
                 report["jobs_per_sec_ewma"] = round(st.ewma_rate, 3)
             return report
+
+    def seed(self, shape: str, wall_s: float, *, fired: bool = False) -> bool:
+        """Restore one shape's baseline from durable history at boot.
+
+        Marks the shape warm (``n = min_samples + 1``): the whole point of
+        seeding is that a post-restart slowdown is judged against the
+        *pre*-restart baseline immediately, not after a fresh cold start.
+        A latched shape stays latched (no re-page on the first sample);
+        an in-band sample re-arms it exactly as it would have live.  Live
+        samples outrank history: a shape that has already observed real
+        traffic this boot is never overwritten.
+        """
+        cfg = self.config
+        if not isinstance(shape, str) or not shape:
+            return False
+        try:
+            wall = float(wall_s)
+        except (TypeError, ValueError):
+            return False
+        if not wall > 0.0:  # rejects zero, negatives, and NaN
+            return False
+        with self._lock:
+            st = self._shapes.get(shape)
+            if st is not None and st.n > 0:
+                return False
+            st = self._shapes.setdefault(shape, _ShapeState())
+            st.ewma_wall = wall
+            st.n = cfg.min_samples + 1
+            st.fired = bool(fired)
+            st.streak = cfg.consecutive if st.fired else 0
+            # padding-bucketed like the live observe() path
+            self._m_baseline.set(wall, shape=shape)  # verifylint: disable=metric-open-label
+            self._m_fired.set(1.0 if st.fired else 0.0, shape=shape)  # verifylint: disable=metric-open-label
+        return True
 
     # -- read side ------------------------------------------------------------
 
@@ -220,3 +266,30 @@ class PerfSentinel:
             "regressions": total,
             "shapes": shapes,
         }
+
+
+def seed_from_telemetry(
+    sentinel: PerfSentinel, values: Dict[str, float]
+) -> int:
+    """Seed baselines + latch state from a flattened telemetry snapshot
+    (``obs.tsdb.last_values`` / ``TelemetryStore.boot_values``).  Returns
+    how many shapes were restored — the ``telemetry_loaded`` event
+    reports it."""
+    from .tsdb import parse_series_key
+
+    baselines: Dict[str, float] = {}
+    latched: Dict[str, bool] = {}
+    for key, value in values.items():
+        name, labels = parse_series_key(key)
+        shape = labels.get("shape")
+        if not shape:
+            continue
+        if name == "verifyd_perf_baseline_wall_seconds":
+            baselines[shape] = value
+        elif name == "verifyd_perf_regression_fired":
+            latched[shape] = value >= 0.5
+    seeded = 0
+    for shape, wall in sorted(baselines.items()):
+        if sentinel.seed(shape, wall, fired=latched.get(shape, False)):
+            seeded += 1
+    return seeded

@@ -330,6 +330,40 @@ class VerifydClient:
             req["part"] = str(part)
         return self._call(req, timeout=timeout)
 
+    def tsq(
+        self,
+        *,
+        res: str | None = None,
+        metric: str | None = None,
+        labels: dict | None = None,
+        since: float | None = None,
+        until: float | None = None,
+        limit: int | None = None,
+        info: bool = False,
+        timeout: float | None = 10.0,
+    ) -> dict:
+        """Query the node's durable telemetry history (``tsq`` CLI).
+        Selectors: ``res`` ring (raw/1m/15m), ``metric`` name substring,
+        ``labels`` equality filters, ``since``/``until`` wall-clock
+        bounds, ``limit`` points per series.  ``info=True`` returns the
+        ring inventory instead of points."""
+        req: dict = {"op": "tsq"}
+        if res is not None:
+            req["res"] = res
+        if metric is not None:
+            req["metric"] = metric
+        if labels:
+            req["labels"] = dict(labels)
+        if since is not None:
+            req["since"] = float(since)
+        if until is not None:
+            req["until"] = float(until)
+        if limit is not None:
+            req["limit"] = int(limit)
+        if info:
+            req["info"] = True
+        return self._call(req, timeout=timeout)
+
     def submit(
         self,
         history_text: str | None = None,

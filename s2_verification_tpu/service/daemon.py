@@ -31,6 +31,7 @@ import itertools
 import json
 import logging
 import os
+import platform
 import sys
 import threading
 import time
@@ -48,8 +49,11 @@ from ..obs.httpd import MetricsServer
 from ..obs.introspect import INTROSPECTOR, ResourceSampler
 from ..obs.log import StructuredLogger
 from ..obs.metrics import MetricsRegistry
-from ..obs.sentinel import PerfSentinel, SentinelConfig
+from ..obs.sentinel import PerfSentinel, SentinelConfig, seed_from_telemetry
 from ..obs.trace import Tracer
+from ..obs.tsdb import TelemetryStore
+from ..obs.tsdb import default_dir as telemetry_default_dir
+from ..obs.tsdb import tsq_request
 from ..utils import events as ev
 from .cache import VerdictCache, history_fingerprint
 from .distsearch import pack_states
@@ -197,6 +201,14 @@ class VerifydConfig:
     dashboard_sample_s: float = 2.0
     #: retained dashboard ticks (sparkline history length)
     dashboard_capacity: int = 240
+    #: durable telemetry store root; None = <state_dir>/telemetry when a
+    #: state dir is set, else disabled.  Registry snapshots are
+    #: delta-encoded into multi-resolution seglog rings that survive
+    #: restarts (the ``tsq`` op / CLI and sentinel re-seeding read them)
+    telemetry_dir: str | None = None
+    #: telemetry sampling cadence (raw ring tick; the 1m/15m rings
+    #: downsample from it); <= 0 disables recording entirely
+    telemetry_sample_s: float = 2.0
     #: RSS watermark for the admission controller, as a fraction of
     #: MemTotal: submits arriving past it are shed with an honest
     #: retry_after instead of queued; <= 0 disables pressure shedding
@@ -279,6 +291,18 @@ class Verifyd:
         )
         self._m_trace_dropped.inc(0)
         self.tracer.drop_hook = lambda _total: self._m_trace_dropped.inc()
+        # Info-style gauge (constant 1): build identity rides the label
+        # set, so scrapes and the fleet plane can tell nodes apart.
+        self.registry.gauge(
+            "verifyd_build_info",
+            "Build identity (value is always 1; the labels carry it)",
+            labelnames=("version", "backend", "python"),
+        ).set(
+            1.0,
+            version=_version.__version__,
+            backend=config.device,
+            python=platform.python_version(),
+        )
         self.health = SLOHealth(
             SLOConfig(
                 availability_target=config.slo_target,
@@ -360,6 +384,42 @@ class Verifyd:
                 recorder=self.flight,
             )
         self.dashboard = None
+        # Durable telemetry: periodic registry snapshots delta-encoded
+        # into multi-resolution seglog rings.  Built after stats (the
+        # degraded-writer + telemetry_loaded sinks) and after the
+        # sentinel, whose baselines the previous run's history re-seeds —
+        # a slowdown across a restart still fires perf_regression.
+        self.telemetry = None
+        self._telemetry_dir = None
+        if config.telemetry_sample_s > 0:
+            tdir = config.telemetry_dir or (
+                telemetry_default_dir(config.state_dir)
+                if config.state_dir
+                else None
+            )
+            if tdir:
+                self._telemetry_dir = tdir
+                self.telemetry = TelemetryStore(
+                    tdir,
+                    self.registry,
+                    sample_s=config.telemetry_sample_s,
+                    fsync=config.fsync,
+                )
+                self.telemetry.writer = DegradedWriter("telemetry", self.stats)
+                seeded = 0
+                if self.sentinel is not None:
+                    _boot_t, boot_values = self.telemetry.boot_values()
+                    seeded = seed_from_telemetry(self.sentinel, boot_values)
+                recs = self.telemetry.recovery_summary().values()
+                self.stats.emit(
+                    "telemetry_loaded",
+                    dir=tdir,
+                    records=sum(r["records"] for r in recs),
+                    segments=sum(r["segments"] for r in recs),
+                    torn_tail_bytes=sum(r["torn_tail_bytes"] for r in recs),
+                    bad_segments=sum(r["bad_segments"] for r in recs),
+                    baselines_seeded=seeded,
+                )
         verdict_dir = (
             os.path.join(config.state_dir, "verdicts") if config.state_dir else None
         )
@@ -521,6 +581,8 @@ class Verifyd:
     def __enter__(self) -> "Verifyd":
         if self.sampler is not None:
             self.sampler.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         if self.cfg.metrics_port is not None:
             if self.cfg.dashboard_sample_s > 0:
                 self.dashboard = Dashboard(
@@ -583,6 +645,11 @@ class Verifyd:
             with contextlib.suppress(Exception):
                 self.sampler.sample_once()
             self.sampler.close()
+        if self.telemetry is not None:
+            # Close takes a final sample and flushes the pending coarse
+            # buckets, so the history's last point is the shutdown state.
+            with contextlib.suppress(Exception):
+                self.telemetry.close()
         self.stats.emit("serve_stop", **self.stats.snapshot())
         self.dump_flight("shutdown")
         if self.alerts is not None:
@@ -947,7 +1014,26 @@ class Verifyd:
                 snap["introspection"] = introspection
                 if self.progress is not None:
                     snap["progress"] = self.progress.rows()
+                if self.telemetry is not None:
+                    snap["telemetry"] = {
+                        "dir": self._telemetry_dir,
+                        "sample_s": self.cfg.telemetry_sample_s,
+                        "recovery": self.telemetry.recovery_summary(),
+                    }
                 return ok(snap)
+            if op == "tsq":
+                if self._telemetry_dir is None:
+                    return err(
+                        ERR_DECODE,
+                        "no telemetry store (daemon runs without "
+                        "--state-dir or --telemetry-dir)",
+                    )
+                payload, bad = tsq_request(
+                    self._telemetry_dir, req, store=self.telemetry
+                )
+                if bad is not None:
+                    return err(ERR_DECODE, bad)
+                return ok(payload)
             if op == "watch":
                 return self._watch(req)
             if op == "trace":

@@ -302,6 +302,18 @@ FRAME_FIELDS = {
         "search": "optional",
         "part": "optional",
     },
+    # Durable-telemetry history query (obs/tsdb.py).  All selectors
+    # optional (old-peer interop): no selector = the raw ring's recent
+    # tail; ``info`` = ring inventory instead of points.
+    "tsq": {
+        "res": "optional",
+        "metric": "optional",
+        "labels": "optional",
+        "since": "optional",
+        "until": "optional",
+        "limit": "optional",
+        "info": "optional",
+    },
     "drain": {"node": "required", "timeout": "optional"},
     "undrain": {"node": "required"},
     # Distributed-search ops (coordinator → backend; service/distsearch.py).

@@ -54,6 +54,7 @@ import hashlib
 import itertools
 import logging
 import os
+import platform
 import threading
 import time
 from collections import OrderedDict
@@ -64,11 +65,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from .. import version as _version
 from ..checker.entries import prepare
 from ..obs.context import TRACE_FIELD, new_trace_id, parse_trace_frame
+from ..obs.federate import FleetScraper, ScrapeTarget
 from ..obs.health import SLOConfig, SLOHealth
 from ..obs.httpd import MetricsServer
 from ..obs.metrics import LATENCY_BUCKETS, MetricsRegistry
 from ..obs.probe import CircuitBreaker, HealthProber, http_health_probe
 from ..obs.trace import Tracer
+from ..obs.tsdb import TelemetryStore
+from ..obs.tsdb import default_dir as telemetry_default_dir
+from ..obs.tsdb import tsq_request
 from ..utils import events as ev
 from .cache import history_fingerprint
 from .prefixstore import affinity_key
@@ -282,6 +287,16 @@ class RouterConfig:
     distsearch_attempt_timeout_s: Optional[float] = None
     #: re-grants per partition before the search degrades to UNKNOWN
     distsearch_max_regrants: int = 3
+    #: fleet-metrics scrape cadence for the federated ``/fleet/*`` plane
+    #: (every backend's families merged under a ``node`` label); <= 0
+    #: disables the scraper entirely
+    scrape_interval_s: float = 2.0
+    #: durable telemetry store root for the router's *own* registry
+    #: (which carries the merged per-node fleet gauges); None =
+    #: <state_dir>/telemetry when a state dir is set, else disabled
+    telemetry_dir: Optional[str] = None
+    #: telemetry sampling cadence; <= 0 disables recording entirely
+    telemetry_sample_s: float = 2.0
     extra: dict = field(default_factory=dict)
 
 
@@ -302,6 +317,18 @@ class VerifydRouter:
         if self._is_tcp_listener and not config.secret:
             raise ValueError("a TCP listener requires a shared secret")
         self.registry = MetricsRegistry()
+        # Info-style gauge (constant 1): build identity rides the label
+        # set — the fleet plane uses it to tell node versions apart.
+        self.registry.gauge(
+            "verifyd_build_info",
+            "Build identity (value is always 1; the labels carry it)",
+            labelnames=("version", "backend", "python"),
+        ).set(
+            1.0,
+            version=_version.__version__,
+            backend="router",
+            python=platform.python_version(),
+        )
         self.tracer = Tracer(config.trace_capacity)
         self.tracer.name_track(0, "router")
         self.health = SLOHealth(
@@ -475,6 +502,48 @@ class VerifydRouter:
             self._m_stolen.inc(0, backend=name)
             self._m_failovers.inc(0, backend=name)
 
+        # Federated fleet metrics plane (obs/federate.py): every
+        # backend's families polled (HTTP /metrics when a healthz URL is
+        # declared, the stats op otherwise) and merged under the closed
+        # ``node`` label into /fleet/metrics + the fleet board.
+        self.federator: Optional[FleetScraper] = None
+        if config.scrape_interval_s > 0:
+            targets = {}
+            for name, b in self._backends.items():
+                url = None
+                if b.spec.healthz_url and b.spec.healthz_url.endswith(
+                    "/healthz"
+                ):
+                    url = (
+                        b.spec.healthz_url[: -len("/healthz")] + "/metrics"
+                    )
+                targets[name] = ScrapeTarget(
+                    metrics_url=url,
+                    stats_fn=functools.partial(self._scrape_stats, name),
+                )
+            self.federator = FleetScraper(
+                self.registry,
+                targets,
+                interval_s=config.scrape_interval_s,
+            )
+        # Durable telemetry over the router's own registry — which now
+        # carries the merged per-node fleet gauges, so the history *is*
+        # the fleet view (``tsq`` against the router answers for all).
+        self.telemetry: Optional[TelemetryStore] = None
+        self._telemetry_dir: Optional[str] = None
+        if config.telemetry_sample_s > 0:
+            tdir = config.telemetry_dir or (
+                telemetry_default_dir(config.state_dir)
+                if config.state_dir
+                else None
+            )
+            if tdir:
+                self._telemetry_dir = tdir
+                self.telemetry = TelemetryStore(
+                    tdir,
+                    self.registry,
+                    sample_s=config.telemetry_sample_s,
+                )
         self.prober = HealthProber(
             {
                 name: self._make_probe(b)
@@ -519,6 +588,11 @@ class VerifydRouter:
             return VerifydClient(address, secret=self.cfg.secret)
         return VerifydClient(address)
 
+    def _scrape_stats(self, name: str) -> dict:
+        """FleetScraper fallback: the backend's ``stats`` op snapshot
+        (its ``metrics`` section) for nodes without a /metrics URL."""
+        return self._backends[name].client.stats(timeout=2.0)
+
     def _make_probe(self, b: _Backend):
         if b.spec.healthz_url:
             url = b.spec.healthz_url
@@ -559,11 +633,18 @@ class VerifydRouter:
     def __enter__(self) -> "VerifydRouter":
         if self.cfg.metrics_port is not None:
             self._metrics_server = MetricsServer(
-                self.registry, self.cfg.metrics_port, health=self.health
+                self.registry,
+                self.cfg.metrics_port,
+                health=self.health,
+                federator=self.federator,
             )
             self.metrics_port = self._metrics_server.port
         self.prober.probe_once()  # routable set is live before the first job
         self.prober.start()
+        if self.federator is not None:
+            self.federator.start()
+        if self.telemetry is not None:
+            self.telemetry.start()
         self._thread = threading.Thread(
             target=self._run, name="router-accept", daemon=True
         )
@@ -581,6 +662,13 @@ class VerifydRouter:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self.prober.close()
+        if self.federator is not None:
+            self.federator.close()
+        if self.telemetry is not None:
+            # Close takes a final sample, so the history's last point
+            # reflects the fleet state at shutdown.
+            with contextlib.suppress(Exception):
+                self.telemetry.close()
         self._pool.shutdown(wait=False)
         if self._grant_ledger is not None:
             with contextlib.suppress(Exception):
@@ -739,6 +827,19 @@ class VerifydRouter:
                 return ok(self.snapshot())
             if op == "fleet":
                 return ok(self.fleet_snapshot())
+            if op == "tsq":
+                if self._telemetry_dir is None:
+                    return err(
+                        ERR_DECODE,
+                        "no telemetry store (router runs without "
+                        "--state-dir or --telemetry-dir)",
+                    )
+                payload, bad = tsq_request(
+                    self._telemetry_dir, req, store=self.telemetry
+                )
+                if bad is not None:
+                    return err(ERR_DECODE, bad)
+                return ok(payload)
             if op == "trace":
                 return ok(
                     await self._loop.run_in_executor(
@@ -1617,9 +1718,20 @@ class VerifydRouter:
             snap["metrics_port"] = self.metrics_port
         snap["metrics"] = self.registry.snapshot()
         snap["slo"] = self.health.snapshot()
+        if self.federator is not None:
+            snap["fleet_slo"] = self.federator.slo_rollup()
+        if self.telemetry is not None:
+            snap["telemetry"] = {
+                "dir": self._telemetry_dir,
+                "sample_s": self.cfg.telemetry_sample_s,
+                "recovery": self.telemetry.recovery_summary(),
+            }
         return snap
 
     def fleet_snapshot(self) -> dict:
+        build = (
+            self.federator.build_info() if self.federator is not None else {}
+        )
         return {
             "ring": {
                 "replicas": self.cfg.ring_replicas,
@@ -1635,6 +1747,7 @@ class VerifydRouter:
                     "breaker": b.breaker.state,
                     "in_flight": b.in_flight,
                     "last_error": b.last_error or None,
+                    "build": build.get(b.name) or None,
                 }
                 for b in (
                     self._backends[n] for n in sorted(self._backends)

@@ -318,7 +318,7 @@ class ServiceStats:
         )
         # Overload protection (ISSUE 10).  Label sets are bounded by
         # construction: reasons come from fixed vocabularies, writer
-        # names from the four durable writers.
+        # names from the five durable writers.
         self._m_cancelled = r.counter(
             "verifyd_jobs_cancelled_total",
             "Jobs cooperatively cancelled after admission, by reason",
@@ -662,12 +662,16 @@ class ServiceStats:
         elif event == "writer_degraded":
             self._counters["writer_degraded_events"] += 1
             writer = str(fields.get("writer", "?"))
-            if writer not in ("flight", "archive", "journal", "cache"):
+            if writer not in (
+                "flight", "archive", "journal", "cache", "telemetry"
+            ):
                 writer = "other"
             self._m_writer_degraded.set(1, writer=writer)
         elif event == "writer_recovered":
             writer = str(fields.get("writer", "?"))
-            if writer not in ("flight", "archive", "journal", "cache"):
+            if writer not in (
+                "flight", "archive", "journal", "cache", "telemetry"
+            ):
                 writer = "other"
             self._m_writer_degraded.set(0, writer=writer)
         elif event == "client_gone":

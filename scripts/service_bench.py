@@ -426,6 +426,14 @@ def main() -> int:
                     "AdmissionController at this RSS watermark (0 "
                     "disables) — the overload gate uses this to prove "
                     "the controller costs nothing on the happy path")
+    ap.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                    help="in-process daemon only: arm the durable "
+                    "telemetry recorder (obs/tsdb) at DIR — the "
+                    "telemetry gate uses this to prove history "
+                    "recording costs ~nothing on the serving path")
+    ap.add_argument("--telemetry-sample", type=float, default=2.0,
+                    help="recorder sampling period with --telemetry-dir "
+                    "(default 2s, the daemon default)")
     ap.add_argument("--follow", action="store_true",
                     help="stream-monitoring mode: verify generated streams "
                     "window-by-window twice — warm (the follow op against "
@@ -592,6 +600,8 @@ def main() -> int:
                 metrics_port=args.metrics_port,
                 mesh_devices=args.mesh_devices,
                 max_rss_frac=args.max_rss_frac,
+                telemetry_dir=args.telemetry_dir,
+                telemetry_sample_s=args.telemetry_sample,
                 fast_admission=args.fast_admission,
                 batching=args.batching,
                 batch_engine=args.batch_engine,

@@ -1,0 +1,466 @@
+"""Obs v4 tests: the federated fleet metrics plane and the
+restart-surviving sentinel baselines.
+
+Covers the FleetScraper merge (closed ``node`` label, dead-node gaps,
+fleet SLO rollup), the router httpd's ``/fleet/*`` surfaces, the ``tsq``
+op end to end on a live daemon, PerfSentinel baseline seeding across a
+simulated restart — the headline: a post-restart slowdown judged against
+the PRE-restart baseline still fires ``perf_regression`` — and the
+doctor's telemetry-history section read cold off a SIGKILLed daemon.
+"""
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import H, fold
+from s2_verification_tpu import cli
+from s2_verification_tpu.obs.federate import (
+    FleetScraper,
+    ScrapeTarget,
+    parse_exposition,
+)
+from s2_verification_tpu.obs.flight import postmortem, render_postmortem
+from s2_verification_tpu.obs.httpd import MetricsServer
+from s2_verification_tpu.obs.metrics import MetricsRegistry
+from s2_verification_tpu.obs.sentinel import (
+    PerfSentinel,
+    SentinelConfig,
+    seed_from_telemetry,
+)
+from s2_verification_tpu.obs.tsdb import (
+    TelemetryStore,
+    default_dir,
+    last_values,
+)
+from s2_verification_tpu.service.client import VerifydClient, VerifydError
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.utils import events as ev
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _good() -> str:
+    h = H()
+    h.append_ok(1, [111], tail=1)
+    h.read_ok(2, tail=1, stream_hash=fold([111]))
+    buf = io.StringIO()
+    ev.write_history(h.events, buf)
+    return buf.getvalue()
+
+
+def _node_registry(jobs=10, queue=2.0, healthy=1.0, version="0.2.0"):
+    """A fake backend registry carrying the families the plane reads."""
+    reg = MetricsRegistry()
+    reg.counter("verifyd_jobs_completed_total", "done").inc(jobs)
+    reg.gauge("verifyd_queue_depth", "depth").set(queue)
+    reg.gauge("verifyd_slo_healthy", "ok").set(healthy)
+    reg.gauge(
+        "verifyd_slo_availability", "avail", labelnames=("window",)
+    ).set(0.99, window="fast")
+    reg.gauge(
+        "verifyd_build_info",
+        "identity",
+        labelnames=("version", "backend", "python"),
+    ).set(1.0, version=version, backend="off", python="3.10")
+    return reg
+
+
+def _stats_target(reg):
+    return ScrapeTarget(stats_fn=lambda: {"metrics": reg.snapshot()})
+
+
+def _scraper(targets, **kw):
+    kw.setdefault("interval_s", 60.0)  # tests drive scrape_once() directly
+    return FleetScraper(MetricsRegistry(), targets, **kw)
+
+
+# -- merge: the closed node label --------------------------------------------
+
+
+def test_merge_injects_node_label_over_every_sample():
+    ra = _node_registry(jobs=10, version="0.2.0")
+    rb = _node_registry(jobs=20, version="0.3.0")
+    sc = _scraper({"a": _stats_target(ra), "b": _stats_target(rb)})
+    assert sc.scrape_once() == {"a": True, "b": True}
+
+    text = sc.render()
+    assert 'verifyd_jobs_completed_total{node="a"} 10' in text
+    assert 'verifyd_jobs_completed_total{node="b"} 20' in text
+    # node is the FIRST label even on already-labeled series
+    assert 'verifyd_slo_availability{node="a",window="fast"}' in text
+    # one TYPE line per family, not one per node
+    assert text.count("# TYPE verifyd_jobs_completed_total") == 1
+    # every sample carries a node value drawn from the closed member set
+    samples, _types, _helps = parse_exposition(text)
+    assert {s[1]["node"] for s in samples} == {"a", "b"}
+
+    # the merged view also lands on the scraper's own registry, which is
+    # what the router's TelemetryStore records for durable fleet history
+    own = sc.registry.render()
+    assert 'verifyd_fleet_node_up{node="a"} 1' in own
+    assert "verifyd_fleet_nodes 2" in own
+
+    # build identity is captured per node for `route fleet`
+    build = sc.build_info()
+    assert build["a"]["version"] == "0.2.0"
+    assert build["b"]["version"] == "0.3.0"
+
+
+def test_dead_backend_is_a_gap_not_a_zero():
+    ra = _node_registry()
+
+    def boom():
+        raise OSError("connection refused")
+
+    sc = _scraper({"a": _stats_target(ra), "b": ScrapeTarget(stats_fn=boom)})
+    assert sc.scrape_once() == {"a": True, "b": False}
+
+    text = sc.render()
+    # the dead node contributes NO samples for real families — a gap —
+    # but the synthetic up family still reports every configured member
+    assert 'node="b"' not in text.replace(
+        'verifyd_fleet_node_up{node="b"} 0', ""
+    )
+    assert 'verifyd_fleet_node_up{node="a"} 1' in text
+    assert 'verifyd_fleet_node_up{node="b"} 0' in text
+    assert sc.registry.get("verifyd_fleet_scrape_errors_total").value(
+        node="b"
+    ) == 1.0
+
+
+def test_http_scrape_with_stats_fallback():
+    ra = _node_registry(jobs=7)
+    srv = MetricsServer(ra, 0)
+    try:
+        sc = _scraper(
+            {
+                "web": ScrapeTarget(metrics_url=srv.url),
+                "op": _stats_target(_node_registry(jobs=9)),
+            }
+        )
+        assert sc.scrape_once() == {"web": True, "op": True}
+        text = sc.render()
+        assert 'verifyd_jobs_completed_total{node="web"} 7' in text
+        assert 'verifyd_jobs_completed_total{node="op"} 9' in text
+        snap = sc.payload()
+        assert snap["nodes"]["web"]["source"] == "http"
+        assert snap["nodes"]["op"]["source"] == "stats"
+    finally:
+        srv.close()
+
+
+# -- fleet SLO rollup --------------------------------------------------------
+
+
+def test_fleet_slo_rollup_extremes_and_gaps():
+    clock = [1000.0]
+    ra = _node_registry(healthy=1.0)
+    rb = _node_registry(healthy=0.0)
+
+    def boom():
+        raise OSError("dead")
+
+    sc = FleetScraper(
+        MetricsRegistry(),
+        {
+            "a": _stats_target(ra),
+            "b": _stats_target(rb),
+            "c": ScrapeTarget(stats_fn=boom),
+        },
+        interval_s=60.0,
+        time_fn=lambda: clock[0],
+    )
+    sc.scrape_once()
+    rollup = sc.slo_rollup()
+    assert rollup["fleet"]["members"] == 3
+    assert rollup["fleet"]["up"] == 2
+    assert rollup["fleet"]["healthy_nodes"] == 1
+    assert rollup["fleet"]["healthy"] is False  # one live node unhealthy
+    assert rollup["nodes"]["a"]["healthy"] is True
+    assert rollup["nodes"]["b"]["healthy"] is False
+    assert rollup["nodes"]["c"] == {"up": False, "last_error": "dead"}
+    assert rollup["fleet"]["availability_min"] == pytest.approx(0.99)
+
+    # time passing without scrapes turns live nodes stale: gaps, not zeros
+    clock[0] += 10_000.0
+    rollup = sc.slo_rollup()
+    assert rollup["fleet"]["up"] == 0
+    assert rollup["nodes"]["a"]["up"] is False
+    assert "jobs_per_sec" not in rollup["nodes"]["a"]
+
+
+# -- the /fleet/* surfaces ---------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5.0) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def test_fleet_endpoints_served_by_obs_httpd():
+    sc = _scraper(
+        {"a": _stats_target(_node_registry()), "b": _stats_target(_node_registry())}
+    )
+    sc.scrape_once()
+    srv = MetricsServer(sc.registry, 0, federator=sc)
+    try:
+        base = f"http://{srv.host}:{srv.port}"
+        status, text = _get(base + "/fleet/metrics")
+        assert status == 200 and 'node="a"' in text and 'node="b"' in text
+        status, text = _get(base + "/fleet/slo")
+        assert status == 200
+        assert json.loads(text)["fleet"]["members"] == 2
+        status, text = _get(base + "/fleet/dashboard")
+        assert status == 200 and "<svg" in text and "verifyd fleet" in text
+        status, text = _get(base + "/fleet/dashboard.json")
+        assert status == 200 and set(json.loads(text)["nodes"]) == {"a", "b"}
+    finally:
+        srv.close()
+
+
+def test_fleet_endpoints_absent_without_federator():
+    reg = MetricsRegistry()
+    srv = MetricsServer(reg, 0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(f"http://{srv.host}:{srv.port}/fleet/metrics")
+        assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+# -- restart-surviving sentinel baselines ------------------------------------
+
+
+def test_slowdown_across_restart_fires_perf_regression(tmp_path):
+    """The satellite-1 headline: boot 1 learns a baseline and dies; boot 2
+    seeds from the telemetry store, so a post-restart slowdown is judged
+    against the PRE-restart baseline and pages — no cold-start amnesia."""
+    tdir = str(tmp_path / "tel")
+    clock = [1000.0]
+
+    # boot 1: live traffic builds a ~20ms baseline, history records it
+    reg1 = MetricsRegistry()
+    s1 = PerfSentinel(SentinelConfig(), registry=reg1, time_fn=lambda: clock[0])
+    for _ in range(12):
+        clock[0] += 1.0
+        assert s1.observe("64x5x8", 0.020, t=clock[0]) is None
+    store = TelemetryStore(tdir, reg1, time_fn=lambda: clock[0])
+    store.sample_once()
+    store.close()  # boot 1 dies
+
+    # boot 2: fresh registry + sentinel, baselines restored from disk
+    reg2 = MetricsRegistry()
+    s2 = PerfSentinel(SentinelConfig(), registry=reg2, time_fn=lambda: clock[0])
+    _t, finals = last_values(tdir)
+    assert seed_from_telemetry(s2, finals) == 1
+    snap = s2.snapshot()["shapes"]["64x5x8"]
+    assert snap["baseline_wall_s"] == pytest.approx(0.020)
+    assert snap["samples"] > SentinelConfig().min_samples  # warm, not cold
+
+    # 4x slowdown right after the restart: fires on the 3rd consecutive
+    # out-of-band sample, exactly as it would have without the restart
+    reports = []
+    for _ in range(3):
+        clock[0] += 1.0
+        reports.append(s2.observe("64x5x8", 0.080, t=clock[0]))
+    assert reports[0] is None and reports[1] is None
+    assert reports[2] is not None and reports[2]["shape"] == "64x5x8"
+    assert reports[2]["baseline_wall_s"] < 0.03  # judged vs boot-1 baseline
+
+    # control: an UNSEEDED sentinel is cold and never fires on the same
+    # three samples — this is precisely the amnesia seeding removes
+    s3 = PerfSentinel(SentinelConfig(), registry=MetricsRegistry())
+    assert all(
+        s3.observe("64x5x8", 0.080, t=2000.0 + i) is None for i in range(3)
+    )
+
+
+def test_latched_shape_stays_latched_across_restart():
+    values = {
+        'verifyd_perf_baseline_wall_seconds{shape="8x3x4"}': 0.02,
+        'verifyd_perf_regression_fired{shape="8x3x4"}': 1.0,
+        'verifyd_perf_baseline_wall_seconds{shape="bad"}': 0.0,  # rejected
+    }
+    s = PerfSentinel(SentinelConfig(), registry=MetricsRegistry())
+    assert seed_from_telemetry(s, values) == 1
+    # still out of band after the restart: latched, must NOT re-page
+    assert s.observe("8x3x4", 0.080, t=1.0) is None
+    # recovery re-arms, a fresh sustained slowdown pages again
+    assert s.observe("8x3x4", 0.020, t=2.0) is None
+    fired = [s.observe("8x3x4", 0.080, t=3.0 + i) for i in range(3)]
+    assert fired[2] is not None
+
+
+def test_live_samples_outrank_history():
+    s = PerfSentinel(SentinelConfig(), registry=MetricsRegistry())
+    s.observe("s", 0.01, t=1.0)
+    assert s.seed("s", 9.9) is False  # already observed live traffic
+    assert s.snapshot()["shapes"]["s"]["baseline_wall_s"] == 0.01
+
+
+# -- daemon integration: boot seeding + the tsq op ---------------------------
+
+
+def test_daemon_boots_seed_sentinel_and_serve_tsq(tmp_path):
+    state_dir = str(tmp_path / "state")
+    # manufacture boot-1 history carrying a sentinel baseline
+    reg = MetricsRegistry()
+    reg.gauge(
+        "verifyd_perf_baseline_wall_seconds", "b", labelnames=("shape",)
+    ).set(0.5, shape="99x9x9")
+    reg.gauge(
+        "verifyd_perf_regression_fired", "f", labelnames=("shape",)
+    ).set(0.0, shape="99x9x9")
+    store = TelemetryStore(default_dir(state_dir), reg, time_fn=lambda: 50.0)
+    store.sample_once()
+    store.close()
+
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+        state_dir=state_dir,
+        telemetry_sample_s=30.0,  # the op forces samples; no thread races
+    )
+    with Verifyd(cfg) as daemon:
+        assert daemon.telemetry is not None
+        # boot 2 seeded the sentinel from boot 1's history
+        shapes = daemon.sentinel.snapshot()["shapes"]
+        assert shapes["99x9x9"]["baseline_wall_s"] == 0.5
+        # build identity is a registry fact on every daemon
+        assert "verifyd_build_info{" in daemon.registry.render()
+
+        client = VerifydClient(cfg.socket_path)
+        assert client.submit(_good(), client="tsq")["verdict"] == 0
+        # the stats op surfaces the store
+        snap = client.stats()
+        assert snap["telemetry"]["dir"] == default_dir(state_dir)
+        # live tsq: the op samples first, so the reply always has points
+        info = client.tsq(info=True)
+        assert info["resolutions"]["raw"]["records"] >= 2  # boot-1 + live
+        out = client.tsq(metric="verifyd_build_info")
+        assert any(
+            "verifyd_build_info" in key for key in out["series"]
+        )
+        # the seeded baseline flows into boot 2's own recorded history
+        out = client.tsq(metric="verifyd_perf_baseline_wall_seconds")
+        (key,) = [k for k in out["series"] if "99x9x9" in k]
+        assert out["series"][key][-1][1] == 0.5
+        with pytest.raises(VerifydError):
+            client.tsq(res="2h")
+
+
+def test_tsq_without_state_dir_is_a_clean_error(tmp_path):
+    cfg = VerifydConfig(
+        socket_path=str(tmp_path / "v.sock"),
+        out_dir=str(tmp_path / "viz"),
+        no_viz=True,
+        stats_log=None,
+        device="off",
+    )
+    with Verifyd(cfg):
+        client = VerifydClient(cfg.socket_path)
+        assert client.submit(_good(), client="x")["verdict"] == 0
+        with pytest.raises(VerifydError, match="no telemetry store"):
+            client.tsq(info=True)
+
+
+# -- doctor: telemetry history off a SIGKILLed daemon ------------------------
+
+_TELEMETRY_CRASH_DRIVER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import logging; logging.disable(logging.CRITICAL)
+
+from s2_verification_tpu.service.daemon import Verifyd, VerifydConfig
+from s2_verification_tpu.service.client import VerifydClient
+
+state_dir, sock, hist_path = sys.argv[1], sys.argv[2], sys.argv[3]
+hist = open(hist_path, encoding="utf-8").read()
+
+cfg = VerifydConfig(socket_path=sock, state_dir=state_dir, device="off",
+                    no_viz=True, stats_log=None, workers=1,
+                    telemetry_sample_s=0.1,
+                    out_dir=os.path.join(state_dir, "viz"))
+daemon = Verifyd(cfg).__enter__()
+client = VerifydClient(sock, timeout=120)
+client.submit(hist, client="tel")
+# the sentinel baseline from that job must land in at least one sample
+while daemon.telemetry.registry.get(
+    "verifyd_telemetry_points_total"
+).value(res="raw") < 4:
+    time.sleep(0.05)
+print("READY", flush=True)
+time.sleep(600)  # parent SIGKILLs us here
+"""
+
+
+def test_doctor_reads_telemetry_of_a_sigkilled_daemon(tmp_path, capsys):
+    state_dir = str(tmp_path / "state")
+    sock = str(tmp_path / "v.sock")
+    hist_path = str(tmp_path / "hist.jsonl")
+    with open(hist_path, "w", encoding="utf-8") as f:
+        f.write(_good())
+    driver = str(tmp_path / "driver.py")
+    with open(driver, "w", encoding="utf-8") as f:
+        f.write(_TELEMETRY_CRASH_DRIVER.format(repo=REPO))
+
+    proc = subprocess.Popen(
+        [sys.executable, driver, state_dir, sock, hist_path],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert line.strip() == "READY", f"driver died early: {line!r}"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # the JSON surface: flushed-per-append rings survived the SIGKILL
+    pm = postmortem(state_dir)
+    assert not pm["clean_shutdown"]
+    tel = pm["telemetry"]
+    assert tel is not None
+    assert tel["resolutions"]["raw"]["records"] >= 4
+    assert tel["resolutions"]["raw"]["recovery"]["bad_segments"] == 0
+    # the sentinel baseline the NEXT boot would seed from is right there
+    assert any(
+        k.startswith("verifyd_perf_baseline_wall_seconds")
+        for k in tel["final_values"]
+    )
+
+    report = render_postmortem(pm)
+    assert "telemetry history" in report
+    assert "sentinel baselines at death" in report
+
+    rc = cli.main(["doctor", "--state-dir", state_dir])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "UNCLEAN DEATH" in out
+    assert "telemetry history" in out
+
+    # cold tsq over the dead state dir agrees with the post-mortem
+    rc = cli.main(
+        ["tsq", "--state-dir", state_dir, "--metric", "verifyd_queue_depth"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "verifyd_queue_depth" in out
